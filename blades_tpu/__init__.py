@@ -1,0 +1,34 @@
+"""blades_tpu: a TPU-native (JAX/XLA) framework for simulating Byzantine
+attacks and robust-aggregation defenses in federated learning.
+
+Capability parity target: bladesteam/blades (see /root/reference and SURVEY.md).
+Design is TPU-first, not a port: a "client" is an index into batched on-device
+arrays; one federated round is a single jitted XLA program (vmapped local SGD
+-> stacked ``[K, D]`` update matrix -> in-graph attack transforms -> jitted
+robust aggregator -> server optimizer step), sharded over a
+``jax.sharding.Mesh``.
+
+Public surface (mirrors the reference ``blades`` package):
+
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import MNIST, CIFAR10
+    from blades_tpu.models.mnist import MLP
+"""
+
+from blades_tpu.version import __version__  # noqa: F401
+
+__all__ = ["__version__"]
+
+# Top-level re-exports resolve lazily (PEP 562) so that importing a
+# subpackage (e.g. blades_tpu.aggregators) never pays for the full stack.
+# Names are added here in the same change that ships their module.
+_LAZY = {}
+
+
+def __getattr__(name):  # PEP 562 lazy imports keep subpackage imports light
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'blades_tpu' has no attribute {name!r}")

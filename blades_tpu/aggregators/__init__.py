@@ -1,0 +1,87 @@
+"""Robust-aggregator registry.
+
+The reference resolves aggregator names by convention-based dynamic import:
+``"xyz" -> blades.aggregators.xyz.Xyz`` (``src/blades/simulator.py:110-116``),
+exporting mean, median, trimmedmean, krum, geomed, autogm, centeredclipping,
+clustering, clippedclustering (``aggregators/__init__.py``) plus unexported
+fltrust/byzantinesgd. All of those names resolve here too, plus dnc,
+multikrum, and signguard.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Type, Union
+
+from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.aggregators.mean import Mean
+from blades_tpu.aggregators.median import Median
+from blades_tpu.aggregators.trimmedmean import Trimmedmean
+from blades_tpu.aggregators.krum import Krum, Multikrum
+from blades_tpu.aggregators.geomed import Geomed
+from blades_tpu.aggregators.autogm import Autogm
+from blades_tpu.aggregators.centeredclipping import Centeredclipping
+from blades_tpu.aggregators.clustering import Clustering
+from blades_tpu.aggregators.clippedclustering import Clippedclustering
+from blades_tpu.aggregators.fltrust import Fltrust
+from blades_tpu.aggregators.byzantinesgd import Byzantinesgd
+from blades_tpu.aggregators.dnc import Dnc
+from blades_tpu.aggregators.signguard import Signguard
+
+AGGREGATORS: Dict[str, Type[Aggregator]] = {
+    "mean": Mean,
+    "median": Median,
+    "trimmedmean": Trimmedmean,
+    "krum": Krum,
+    "multikrum": Multikrum,
+    "geomed": Geomed,
+    "autogm": Autogm,
+    "centeredclipping": Centeredclipping,
+    "clustering": Clustering,
+    "clippedclustering": Clippedclustering,
+    "fltrust": Fltrust,
+    "byzantinesgd": Byzantinesgd,
+    "dnc": Dnc,
+    "signguard": Signguard,
+}
+
+
+def get_aggregator(name_or_fn: Union[str, Aggregator, Callable], **kwargs) -> Aggregator:
+    """Resolve a name or pass through a custom aggregator callable/instance."""
+    if isinstance(name_or_fn, Aggregator):
+        return name_or_fn
+    if callable(name_or_fn) and not isinstance(name_or_fn, str):
+        return _wrap_callable(name_or_fn)
+    try:
+        cls = AGGREGATORS[name_or_fn]
+    except KeyError:
+        raise ValueError(
+            f"Unknown aggregator {name_or_fn!r}; available: {sorted(AGGREGATORS)}"
+        ) from None
+    return cls(**kwargs)
+
+
+def _wrap_callable(fn: Callable) -> Aggregator:
+    """Adapt a bare ``updates -> vector`` function (the reference accepts
+    custom aggregators as plain callables, ``simulator.py:110-116``)."""
+
+    class _Custom(Aggregator):
+        def aggregate(self, updates, state=(), **ctx):
+            return fn(updates), state
+
+        def __repr__(self):
+            return getattr(fn, "__name__", "custom")
+
+    return _Custom()
+
+
+def register_aggregator(name: str, cls: Type[Aggregator]) -> None:
+    """Extension hook for user-defined defenses."""
+    AGGREGATORS[name] = cls
+
+
+__all__ = [
+    "Aggregator", "Mean", "Median", "Trimmedmean", "Krum", "Multikrum",
+    "Geomed", "Autogm", "Centeredclipping", "Clustering", "Clippedclustering",
+    "Fltrust", "Byzantinesgd", "Dnc", "Signguard",
+    "AGGREGATORS", "get_aggregator", "register_aggregator",
+]

@@ -1,0 +1,82 @@
+"""Aggregator framework.
+
+Reference counterpart: ``_BaseAggregator`` (``src/blades/aggregators/mean.py:9-40``),
+whose instances are host-side callables ``List[client|tensor] -> tensor`` that
+run on the driver in pure Python — the serial bottleneck called out in
+SURVEY.md section 3 ("Where work actually happens").
+
+TPU-native design: an aggregator is a *pure function* over the on-device
+``[K, D]`` update matrix,
+
+    aggregate(updates, state, **ctx) -> (aggregated [D], new_state)
+
+traced inside the same jitted round program as local training, so defenses
+compile to XLA reductions and never leave the device. Stateful defenses
+(centered clipping's momentum, clipped clustering's norm history) thread
+explicit state instead of mutating ``self`` — that is what makes them
+jit-compatible and checkpointable.
+
+``__call__`` is a host-side convenience wrapper with reference-call parity
+(accepts a stacked matrix, a list of vectors, or a list of client handles,
+mirroring ``_get_updates`` at ``mean.py:21-28``) that maintains the state
+internally and jit-caches the apply function.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Aggregator:
+    """Base class for robust aggregators.
+
+    Subclasses implement :meth:`aggregate`. Construction-time hyperparameters
+    are plain Python attributes (static under jit).
+    """
+
+    #: set by subclasses that carry state across rounds
+    stateful: bool = False
+
+    def init_state(self, num_clients: int, dim: int) -> Any:
+        """Initial carry for stateful aggregators; ``()`` when stateless."""
+        return ()
+
+    def aggregate(
+        self,
+        updates: jnp.ndarray,
+        state: Any = (),
+        *,
+        byz_mask: Optional[jnp.ndarray] = None,
+        trusted_mask: Optional[jnp.ndarray] = None,
+        params_flat: Optional[jnp.ndarray] = None,
+        key: Optional[jax.Array] = None,
+    ) -> Tuple[jnp.ndarray, Any]:
+        raise NotImplementedError
+
+    # -- host-side convenience ------------------------------------------------
+
+    def _coerce(self, inputs) -> jnp.ndarray:
+        """Normalize inputs to a stacked ``[K, D]`` matrix (parity with the
+        reference's ``_get_updates``)."""
+        if isinstance(inputs, (list, tuple)):
+            if len(inputs) and hasattr(inputs[0], "get_update"):
+                inputs = [c.get_update() for c in inputs]
+            return jnp.stack([jnp.asarray(u) for u in inputs], axis=0)
+        return jnp.asarray(inputs)
+
+    def __call__(self, inputs, **ctx) -> jnp.ndarray:
+        updates = self._coerce(inputs)
+        if not hasattr(self, "_state"):
+            self._state = self.init_state(*updates.shape)
+        agg, self._state = self.aggregate(updates, self._state, **ctx)
+        return agg
+
+    def reset(self) -> None:
+        if hasattr(self, "_state"):
+            del self._state
+
+    def __repr__(self) -> str:
+        return type(self).__name__

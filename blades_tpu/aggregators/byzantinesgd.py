@@ -1,0 +1,80 @@
+"""ByzantineSGD filter (Alistarh et al., NeurIPS 2018).
+
+Reference: ``ByzantineSGD`` (``src/blades/aggregators/byzantinesgd.py:8-80``)
+— unexported there, implemented here for full catalog coverage. Per-worker
+scalar accumulators ``A_i += <u_i, theta - theta_0>`` and vector accumulators
+``B_i += u_i`` feed three median-distance filters (thresholds th_A/th_B/th_V);
+workers failing any filter are permanently removed from the good set.
+
+State (A, B, good mask, initial params) is explicit jit state; the current
+flat parameter vector arrives via the ``params_flat`` context. The
+``vector_median`` scan (first worker within ``threshold`` of more than half
+the others, ``byzantinesgd.py:35-43``) becomes a masked matrix reduction.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.ops.distances import pairwise_sq_euclidean
+
+
+def _vector_median_idx(vs: jnp.ndarray, threshold: float) -> jnp.ndarray:
+    """Index of the first row within ``threshold`` of > half the rows."""
+    d = jnp.sqrt(pairwise_sq_euclidean(vs))
+    counts = jnp.sum(d <= threshold, axis=1)  # includes self, as the reference does
+    ok = counts > vs.shape[0] / 2
+    return jnp.argmax(ok)  # first eligible index (0 if none — reference raises)
+
+
+class Byzantinesgd(Aggregator):
+    stateful = True
+
+    def __init__(self, th_A: float = 1.0, th_B: float = 1.0, th_V: float = 1.0):
+        self.th_A = th_A
+        self.th_B = th_B
+        self.th_V = th_V
+
+    def init_state(self, num_clients: int, dim: int):
+        # fixed pytree structure across calls (jit/scan carry contract): the
+        # initial parameter snapshot is captured on the first call, flagged
+        # by `initialized` rather than a None sentinel.
+        return {
+            "A": jnp.zeros((num_clients,), dtype=jnp.float32),
+            "B": jnp.zeros((num_clients, dim), dtype=jnp.float32),
+            "good": jnp.ones((num_clients,), dtype=bool),
+            "init_params": jnp.zeros((dim,), dtype=jnp.float32),
+            "initialized": jnp.zeros((), dtype=bool),
+        }
+
+    def aggregate(self, updates, state, *, params_flat=None, **ctx):
+        if params_flat is None:
+            raise ValueError("byzantinesgd needs params_flat context")
+        init_params = jnp.where(
+            state["initialized"], state["init_params"], params_flat
+        )
+        model_diff = params_flat - init_params
+
+        A = state["A"] + updates @ model_diff
+        B = state["B"] + updates
+
+        A_med = jnp.median(A)
+        B_med = B[_vector_median_idx(B, self.th_B)]
+        g_med = updates[_vector_median_idx(updates, 2 * self.th_V)]
+
+        a_ok = jnp.abs(A - A_med) <= self.th_A
+        b_ok = jnp.sqrt(jnp.sum((B - B_med) ** 2, axis=1)) <= self.th_B
+        g_ok = jnp.sqrt(jnp.sum((updates - g_med) ** 2, axis=1)) <= 4 * self.th_V
+        good = state["good"] & a_ok & b_ok & g_ok
+
+        w = good.astype(updates.dtype)
+        agg = (w @ updates) / jnp.maximum(jnp.sum(w), 1.0)
+        new_state = {
+            "A": A,
+            "B": B,
+            "good": good,
+            "init_params": init_params,
+            "initialized": jnp.ones((), dtype=bool),
+        }
+        return agg, new_state

@@ -1,0 +1,72 @@
+"""Clipped clustering (Li et al., TechRxiv 2022).
+
+Reference: ``Clippedclustering`` (``src/blades/aggregators/clippedclustering.py:20-66``):
+clip each update to the median of *historical* L2 norms (the history grows
+unboundedly, ``clippedclustering.py:34,41-43``), then cluster on cosine
+distance (diag 0, NaN -> 2) and average the majority cluster.
+
+The unbounded Python list is replaced by a fixed-capacity ring buffer carried
+as explicit jit state; with the default capacity the buffer only wraps after
+``history_cap / K`` rounds (65k scalars ~ 256 KB), beyond any reference run
+length. Clipping uses the same ``min(1, tau / (|u| + 1e-6))`` coefficient as
+the reference's ``clip_tensor_norm_`` (``aggregators/torch_utils.py:96-107``),
+applied only to rows whose norm exceeds the threshold.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.aggregators.clustering import Clustering
+
+
+class Clippedclustering(Aggregator):
+    stateful = True
+
+    def __init__(self, tau: float = None, history_cap: int = 65536):
+        self.tau = tau
+        self.history_cap = history_cap
+        self._clustering = Clustering(metric="distance")
+
+    def init_state(self, num_clients: int, dim: int):
+        # `pos` is the ring write pointer (wraps); `count` the clamped number
+        # of live entries used for the masked median.
+        return {
+            "norms": jnp.zeros((self.history_cap,), dtype=jnp.float32),
+            "pos": jnp.zeros((), dtype=jnp.int32),
+            "count": jnp.zeros((), dtype=jnp.int32),
+        }
+
+    def _masked_median(self, norms, n):
+        """Median of the first ``n`` live entries (numpy convention: midpoint
+        of the two central order statistics for even n)."""
+        cap = norms.shape[0]
+        filled = jnp.arange(cap) < n
+        s = jnp.sort(jnp.where(filled, norms, jnp.inf))
+        lo = s[jnp.maximum((n - 1) // 2, 0)]
+        hi = s[jnp.maximum(n // 2, 0)]
+        return (lo + hi) / 2.0
+
+    def aggregate(self, updates, state, **ctx):
+        k = updates.shape[0]
+        norms = jnp.sqrt(jnp.maximum(jnp.sum(updates**2, axis=1), 0.0))
+
+        # append this round's K norms into the ring buffer
+        cap = self.history_cap
+        idx = (state["pos"] + jnp.arange(k)) % cap
+        hist = state["norms"].at[idx].set(norms.astype(jnp.float32))
+        pos = (state["pos"] + k) % cap
+        count = jnp.minimum(state["count"] + k, cap)
+        new_state = {"norms": hist, "pos": pos, "count": count}
+
+        if self.tau is not None:
+            threshold = jnp.asarray(self.tau, dtype=updates.dtype)
+        else:
+            threshold = self._masked_median(hist, count).astype(updates.dtype)
+
+        coef = jnp.minimum(1.0, threshold / (norms + 1e-6))
+        clipped = jnp.where((norms > threshold)[:, None], updates * coef[:, None], updates)
+
+        agg, _ = self._clustering.aggregate(clipped)
+        return agg, new_state

@@ -1,0 +1,49 @@
+"""Clustering defense (Sattler et al., 2020).
+
+Reference: ``Clustering`` (``src/blades/aggregators/clustering.py:13-44``):
+build the K x K matrix ``M[i,j] = cosine_similarity(u_i, u_j)`` with diagonal
+1 and NaN -> -1 (``clustering.py:26-35``), run complete-linkage agglomerative
+clustering into two groups, and average the majority cluster.
+
+Fidelity note: the reference feeds the *similarity* matrix to
+``AgglomerativeClustering`` as a precomputed *distance* (``clustering.py:38``),
+so the most-similar pairs merge last. We reproduce that exact matrix by
+default (``metric='similarity'``); ``metric='distance'`` gives the intended
+cosine-distance clustering (which is what ``Clippedclustering`` uses).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.ops.clustering import complete_linkage_two_clusters, majority_cluster_mean
+from blades_tpu.ops.distances import pairwise_cosine_similarity
+
+
+class Clustering(Aggregator):
+    def __init__(self, metric: str = "similarity"):
+        if metric not in ("similarity", "distance"):
+            raise ValueError(metric)
+        self.metric = metric
+
+    def _matrix(self, updates):
+        sim = pairwise_cosine_similarity(updates)
+        # zero-norm updates have undefined cosine; the reference's scipy path
+        # yields NaN there, mapped to -1 similarity / 2 distance
+        # (clustering.py:34, clippedclustering.py:59). Our normalized matmul
+        # clamps norms instead of producing NaN, so apply the mapping
+        # explicitly to zero rows.
+        zero = jnp.sum(updates * updates, axis=-1) == 0.0
+        undef = zero[:, None] | zero[None, :]
+        eye = jnp.eye(sim.shape[0], dtype=bool)
+        if self.metric == "similarity":
+            # parity: diag = 1 - cosine_dist(x,x) = 1
+            m = jnp.where(undef, -1.0, sim)
+            return jnp.where(eye, 1.0, m)
+        m = jnp.where(undef, 2.0, 1.0 - sim)
+        return jnp.where(eye, 0.0, m)
+
+    def aggregate(self, updates, state=(), **ctx):
+        labels = complete_linkage_two_clusters(self._matrix(updates))
+        return majority_cluster_mean(updates, labels), state

@@ -1,0 +1,75 @@
+"""Divide-and-Conquer aggregation (Shejwalkar & Houmansadr, NDSS 2021).
+
+Not present in the reference's aggregator package but named in the driver
+benchmark configs (BASELINE.md config 5), so it is a first-class defense here.
+
+Per iteration: subsample ``sub_dim`` coordinates, mean-center the submatrix,
+estimate its top right-singular vector by power iteration (jit-friendly, no
+full SVD), score each client by its squared projection onto that direction,
+and flag the ``c * f`` highest-scoring clients as outliers. The final
+aggregate is the mean of clients that survive every iteration.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from blades_tpu.aggregators.base import Aggregator
+
+
+def _top_singular_dir(x: jnp.ndarray, iters: int, key: jax.Array) -> jnp.ndarray:
+    """Top right-singular vector of ``x [K, d]`` via power iteration on x^T x."""
+    v = jax.random.normal(key, (x.shape[1],), dtype=x.dtype)
+    v = v / jnp.sqrt(jnp.sum(v**2))
+
+    def body(_, v):
+        v = x.T @ (x @ v)
+        return v / jnp.sqrt(jnp.maximum(jnp.sum(v**2), 1e-24))
+
+    return jax.lax.fori_loop(0, iters, body, v)
+
+
+class Dnc(Aggregator):
+    def __init__(
+        self,
+        num_byzantine: int = 5,
+        sub_dim: int = 10000,
+        num_iters: int = 5,
+        filter_frac: float = 1.0,
+        power_iters: int = 10,
+    ):
+        self.f = num_byzantine
+        self.sub_dim = sub_dim
+        self.num_iters = num_iters
+        self.filter_frac = filter_frac
+        self.power_iters = power_iters
+
+    def aggregate(self, updates, state=(), *, key=None, **ctx):
+        if key is None:
+            key = jax.random.key(0)
+        k, d = updates.shape
+        sub_dim = min(self.sub_dim, d)
+        n_remove = int(self.filter_frac * self.f)
+        n_remove = min(n_remove, k - 1)
+
+        def one_iter(carry, subkey):
+            good = carry
+            k_idx, k_init = jax.random.split(subkey)
+            idx = jax.random.choice(k_idx, d, shape=(sub_dim,), replace=False)
+            sub = updates[:, idx]
+            centered = sub - jnp.mean(sub, axis=0)
+            v = _top_singular_dir(centered, self.power_iters, k_init)
+            scores = (centered @ v) ** 2
+            # keep everyone except the n_remove largest scores
+            cutoff = jnp.sort(scores)[k - n_remove - 1]
+            good = good & (scores <= cutoff)
+            return good, None
+
+        keys = jax.random.split(key, self.num_iters)
+        good, _ = jax.lax.scan(one_iter, jnp.ones((k,), dtype=bool), keys)
+        w = good.astype(updates.dtype)
+        return (w @ updates) / jnp.maximum(jnp.sum(w), 1.0), state
+
+    def __repr__(self):
+        return f"DnC (f={self.f}, iters={self.num_iters})"

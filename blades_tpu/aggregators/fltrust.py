@@ -1,0 +1,50 @@
+"""FLTrust (Cao et al., NDSS 2021).
+
+Reference: ``Fltrust`` (``src/blades/aggregators/fltrust.py:8-38``): requires
+exactly one trusted client; trust score of every untrusted update is
+``relu(cos_sim(trusted, u))`` (cosine eps 1e-6, matching torch's
+``CosineSimilarity``), each untrusted update is rescaled to the trusted
+update's norm, and the result is the trust-weighted average over the
+*untrusted* population.
+
+Here the trusted client is identified by the ``trusted_mask`` context array
+(set via ``Simulator.set_trusted_clients``, reference
+``simulator.py:143-151``) and the whole defense is masked arithmetic over the
+``[K, D]`` matrix — no Python-side client filtering.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blades_tpu.aggregators.base import Aggregator
+
+
+class Fltrust(Aggregator):
+    def __call__(self, inputs, **ctx):
+        # host-side guard mirroring the reference's `assert len(trusted) == 1`
+        mask = ctx.get("trusted_mask")
+        if mask is not None and int(jnp.sum(jnp.asarray(mask))) != 1:
+            raise ValueError("fltrust requires exactly one trusted client")
+        return super().__call__(inputs, **ctx)
+
+    def aggregate(self, updates, state=(), *, trusted_mask=None, **ctx):
+        if trusted_mask is None:
+            raise ValueError(
+                "fltrust requires a trusted_mask (set_trusted_clients)"
+            )
+        trusted_mask = jnp.asarray(trusted_mask).astype(bool)
+        t_idx = jnp.argmax(trusted_mask)
+        trusted = updates[t_idx]
+        t_norm = jnp.sqrt(jnp.sum(trusted**2))
+
+        norms = jnp.sqrt(jnp.maximum(jnp.sum(updates**2, axis=1), 0.0))
+        cos = (updates @ trusted) / jnp.maximum(norms * t_norm, 1e-6)
+        ts = jnp.maximum(cos, 0.0) * (~trusted_mask)  # relu + exclude trusted
+
+        rescaled = updates * (t_norm / jnp.maximum(norms, 1e-24))[:, None]
+        # when every untrusted update opposes the trusted one (all trust
+        # scores zero) the reference divides 0/0 -> NaN; return the zero
+        # vector instead (skip the round) — safer and still "no information
+        # accepted from untrusted clients".
+        return (ts @ rescaled) / jnp.maximum(jnp.sum(ts), 1e-12), state

@@ -1,0 +1,68 @@
+"""Krum / Multi-Krum (Blanchard et al., NeurIPS 2017).
+
+Reference: ``Krum`` (``src/blades/aggregators/krum.py:9-125``), which builds
+pairwise distances with O(K^2) Python dict loops (``krum.py:73-91``) and
+scores each client by the sum of its ``n - f - 2`` smallest distances
+(``krum.py:9-26``). Here the distance matrix is a single MXU matmul
+(``|a-b|^2 = |a|^2 + |b|^2 - 2ab^T``) and scoring is one sort — the whole
+defense is an XLA program.
+
+Fidelity note: the reference squares the *already squared* distances when
+scoring (``krum.py:22`` on top of ``krum.py:91``), i.e. ranks by sums of
+``d^4``. The paper specifies squared Euclidean distance; we default to the
+paper (``distance_power=2``) and expose ``distance_power=4`` for bit-parity
+with the reference's accidental behavior.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.ops.distances import pairwise_sq_euclidean
+
+
+class Krum(Aggregator):
+    def __init__(
+        self,
+        num_clients: int = None,
+        num_byzantine: int = 5,
+        num_selected: int = 1,
+        distance_power: int = 2,
+    ):
+        # num_clients accepted for reference ctor parity (`krum.py:118`) but
+        # derived from the update matrix at trace time.
+        self.f = num_byzantine
+        self.m = num_selected
+        self.distance_power = distance_power
+
+    def scores(self, updates: jnp.ndarray) -> jnp.ndarray:
+        k = updates.shape[0]
+        if 2 * self.f + 2 > k:
+            raise ValueError(
+                f"Too many Byzantine workers: 2*{self.f}+2 > {k}"
+            )
+        d2 = pairwise_sq_euclidean(updates)
+        if self.distance_power == 4:
+            d2 = d2 * d2
+        # exclude self-distance by pushing the diagonal to +inf before sorting
+        d2 = d2 + jnp.diag(jnp.full((k,), jnp.inf, dtype=updates.dtype))
+        nearest = jnp.sort(d2, axis=1)[:, : k - self.f - 2]
+        return jnp.sum(nearest, axis=1)
+
+    def aggregate(self, updates, state=(), **ctx):
+        scores = self.scores(updates)
+        top_m = jnp.argsort(scores)[: self.m]
+        # the reference *sums* the selected updates (`krum.py:120`); for the
+        # default m=1 this is the single closest vector.
+        return jnp.sum(updates[top_m], axis=0), state
+
+    def __repr__(self):
+        return f"Krum (m={self.m})"
+
+
+class Multikrum(Krum):
+    """Multi-Krum: select the m best-scoring clients (m > 1)."""
+
+    def __init__(self, num_clients: int = None, num_byzantine: int = 5, num_selected: int = 5):
+        super().__init__(num_clients, num_byzantine, num_selected)

@@ -1,0 +1,14 @@
+"""Sample mean. Reference: ``Mean`` (``src/blades/aggregators/mean.py:62-76``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blades_tpu.aggregators.base import Aggregator
+
+
+class Mean(Aggregator):
+    r"""Computes the sample mean over client updates: one XLA row reduction."""
+
+    def aggregate(self, updates, state=(), **ctx):
+        return jnp.mean(updates, axis=0), state

@@ -1,0 +1,18 @@
+"""Coordinate-wise median (Yin et al., 2018).
+
+Reference: ``Median`` (``src/blades/aggregators/median.py:9-25``). The
+reference symmetrizes torch's lower-median — ``(med(x) - med(-x)) / 2`` — to
+obtain the midpoint for even K; ``jnp.median`` already returns the midpoint,
+so the two are numerically identical.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blades_tpu.aggregators.base import Aggregator
+
+
+class Median(Aggregator):
+    def aggregate(self, updates, state=(), **ctx):
+        return jnp.median(updates, axis=0), state

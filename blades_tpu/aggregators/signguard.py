@@ -1,0 +1,57 @@
+"""SignGuard (Xu et al., ICDCS 2022) — sign-statistics + norm filtering.
+
+Extra defense beyond the reference's catalog (the reference exports eight
+schemes, ``src/blades/aggregators/__init__.py``); included because it is a
+standard member of the robust-aggregation family this framework targets.
+
+Two filters, both on-device:
+  1. norm filter: keep clients whose L2 norm lies within
+     ``[lower * median_norm, upper * median_norm]``;
+  2. sign filter: cluster clients on their (pos, zero, neg) gradient-sign
+     statistics with complete-linkage 2-clustering and keep the majority.
+The aggregate is the mean of clients passing both, with norms clipped to the
+median.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.ops.clustering import complete_linkage_two_clusters
+
+
+class Signguard(Aggregator):
+    def __init__(self, lower: float = 0.1, upper: float = 3.0):
+        self.lower = lower
+        self.upper = upper
+
+    def aggregate(self, updates, state=(), **ctx):
+        k = updates.shape[0]
+        norms = jnp.sqrt(jnp.maximum(jnp.sum(updates**2, axis=1), 1e-24))
+        med = jnp.median(norms)
+        norm_ok = (norms >= self.lower * med) & (norms <= self.upper * med)
+
+        sign = jnp.sign(updates)
+        feats = jnp.stack(
+            [
+                jnp.mean(sign > 0, axis=1),
+                jnp.mean(sign == 0, axis=1),
+                jnp.mean(sign < 0, axis=1),
+            ],
+            axis=1,
+        )
+        dist = jnp.sqrt(
+            jnp.maximum(
+                jnp.sum((feats[:, None, :] - feats[None, :, :]) ** 2, axis=-1), 0.0
+            )
+        )
+        labels = complete_linkage_two_clusters(dist)
+        size1 = jnp.sum(labels)
+        majority = jnp.where(size1 > k - size1, 1, 0)
+        sign_ok = labels == majority
+
+        keep = (norm_ok & sign_ok).astype(updates.dtype)
+        clip = jnp.minimum(1.0, med / norms)
+        clipped = updates * clip[:, None]
+        return (keep @ clipped) / jnp.maximum(jnp.sum(keep), 1.0), state

@@ -1,0 +1,35 @@
+"""Coordinate-wise trimmed mean (Yin et al., 2018).
+
+Reference: ``Trimmedmean`` (``src/blades/aggregators/trimmedmean.py:9-45``):
+drop the largest and smallest ``b`` values per coordinate via two ``topk``
+calls, average the rest; ``b`` auto-shrinks when ``K - 2b <= 0``
+(``trimmedmean.py:29-36``). Here it is one sort along the client axis plus a
+static slice — K is a trace-time constant, so XLA sees a fixed-shape sort.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blades_tpu.aggregators.base import Aggregator
+
+
+class Trimmedmean(Aggregator):
+    def __init__(self, num_byzantine: int = 5, nb: int = None):
+        # `nb` mirrors the reference ctor arg name (`trimmedmean.py:24`).
+        self.b = nb if nb is not None else num_byzantine
+
+    def aggregate(self, updates, state=(), **ctx):
+        k = updates.shape[0]
+        b = self.b
+        while k - 2 * b <= 0:  # trace-time auto-shrink, parity with reference
+            b -= 1
+        if b < 0:
+            raise RuntimeError(f"cannot trim {self.b} from {k} clients")
+        if b == 0:
+            return jnp.mean(updates, axis=0), state
+        s = jnp.sort(updates, axis=0)
+        return jnp.mean(s[b : k - b], axis=0), state
+
+    def __repr__(self):
+        return f"Trimmed Mean (b={self.b})"

@@ -1,0 +1,11 @@
+from blades_tpu.ops.pytree import (  # noqa: F401
+    flat_dim,
+    make_unraveler,
+    ravel,
+    tree_stack,
+    tree_unstack,
+)
+from blades_tpu.ops.distances import (  # noqa: F401
+    pairwise_sq_euclidean,
+    pairwise_cosine_similarity,
+)

@@ -1,0 +1,32 @@
+"""Batched distance kernels for robust aggregation.
+
+The reference computes pairwise distances with O(K^2) Python dict-of-dict
+loops (``src/blades/aggregators/krum.py:73-91``) and per-pair
+``scipy.spatial.distance.cosine`` calls
+(``src/blades/aggregators/clustering.py:28-33``). On TPU both are a single
+MXU matmul over the ``[K, D]`` update matrix.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def pairwise_sq_euclidean(x: jnp.ndarray) -> jnp.ndarray:
+    """``[K, D] -> [K, K]`` matrix of squared Euclidean distances.
+
+    Uses ``|a-b|^2 = |a|^2 + |b|^2 - 2 a.b`` so the O(K^2 D) work is one
+    matmul on the MXU; clamps tiny negatives from cancellation.
+    """
+    sq = jnp.sum(x * x, axis=-1)
+    gram = x @ x.T
+    d2 = sq[:, None] + sq[None, :] - 2.0 * gram
+    return jnp.maximum(d2, 0.0)
+
+
+def pairwise_cosine_similarity(x: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
+    """``[K, D] -> [K, K]`` cosine-similarity matrix via one normalized matmul."""
+    norms = jnp.sqrt(jnp.sum(x * x, axis=-1))
+    xn = x / jnp.maximum(norms, eps)[:, None]
+    sim = xn @ xn.T
+    return jnp.clip(sim, -1.0, 1.0)

@@ -1,0 +1,49 @@
+"""Pytree <-> flat-vector utilities.
+
+In the reference, every client flattens ``named_parameters`` into a single 1-D
+CPU tensor (``src/blades/client.py:216-228``) and the server slices the
+aggregated vector back into per-parameter grads
+(``src/blades/server.py:66-74``). Here the same mapping is a pair of pure
+functions built once from a template pytree: ``ravel`` (tree -> ``[D]``) and an
+``unravel`` closure (``[D]`` -> tree), both jit-friendly, so the ``[K, D]``
+update matrix lives on device and the reshape is free for XLA to fuse.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def ravel(tree: Any) -> jnp.ndarray:
+    """Flatten a pytree of arrays into a single 1-D vector."""
+    flat, _ = ravel_pytree(tree)
+    return flat
+
+
+def make_unraveler(template: Any) -> Tuple[int, Callable[[jnp.ndarray], Any]]:
+    """Return ``(D, unravel)`` for the given template pytree.
+
+    ``unravel`` maps a ``[D]`` vector back to the template's structure; it is a
+    pure function safe to close over inside jit.
+    """
+    flat, unravel = ravel_pytree(template)
+    return int(flat.shape[0]), unravel
+
+
+def flat_dim(tree: Any) -> int:
+    """Number of scalar parameters in the pytree."""
+    return int(sum(jnp.size(x) for x in jax.tree_util.tree_leaves(tree)))
+
+
+def tree_stack(trees: list) -> Any:
+    """Stack a list of identical-structure pytrees along a new leading axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+
+
+def tree_unstack(tree: Any, num: int) -> list:
+    """Inverse of :func:`tree_stack`."""
+    return [jax.tree_util.tree_map(lambda x: x[i], tree) for i in range(num)]

@@ -1,0 +1,7 @@
+from blades_tpu.parallel.mesh import (  # noqa: F401
+    CLIENTS_AXIS,
+    MODEL_AXIS,
+    ShardingPlan,
+    make_mesh,
+    make_plan,
+)

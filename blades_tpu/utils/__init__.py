@@ -1,0 +1,3 @@
+from blades_tpu.utils.rng import key_for_round, key_per_client  # noqa: F401
+from blades_tpu.utils.logging import initialize_logger  # noqa: F401
+from blades_tpu.utils.metrics import top1_accuracy, accuracy  # noqa: F401

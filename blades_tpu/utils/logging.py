@@ -1,0 +1,53 @@
+"""Two-file run logging with reference parity.
+
+The reference writes a ``stats`` file (one Python-dict repr per line, typed by
+``_meta.type``) and a free-text ``debug`` file, wiping the log dir on init
+(``src/blades/utils.py:67-95``). Downstream analysis parses the stats file
+line-by-line (``examples/Simulation on MNIST.py:69-83``), so the format is
+kept identical.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+from importlib import reload
+
+
+def initialize_logger(log_root: str) -> None:
+    """(Re)create ``log_root`` and attach fresh ``stats``/``debug`` loggers."""
+    logging.shutdown()
+    reload(logging)
+    if os.path.exists(log_root):
+        shutil.rmtree(log_root)
+    os.makedirs(log_root)
+
+    json_logger = logging.getLogger("stats")
+    json_logger.setLevel(logging.INFO)
+    fh = logging.FileHandler(os.path.join(log_root, "stats"))
+    fh.setLevel(logging.INFO)
+    fh.setFormatter(logging.Formatter("%(message)s"))
+    json_logger.addHandler(fh)
+
+    debug_logger = logging.getLogger("debug")
+    debug_logger.setLevel(logging.INFO)
+    fh = logging.FileHandler(os.path.join(log_root, "debug"))
+    fh.setLevel(logging.INFO)
+    fh.setFormatter(logging.Formatter("%(message)s"))
+    debug_logger.addHandler(fh)
+
+
+def read_stats(log_root: str, type_filter: str | None = None) -> list:
+    """Parse a ``stats`` file back into dicts (the reference leaves this to
+    each consumer, e.g. ``examples/Simulation on MNIST.py:69-83``)."""
+    out = []
+    with open(os.path.join(log_root, "stats")) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = eval(line, {"__builtins__": {}}, {"nan": float("nan"), "inf": float("inf")})
+            if type_filter is None or rec.get("_meta", {}).get("type") == type_filter:
+                out.append(rec)
+    return out

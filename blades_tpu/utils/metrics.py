@@ -1,0 +1,27 @@
+"""Metric callables.
+
+Pluggable metric registry mirrors the reference (``{name: fn(output,
+target)}``, ``src/blades/simulator.py:57,76``; ``top1_accuracy`` at
+``src/blades/utils.py:55-56`` returns percent). Metrics here are pure JAX
+functions usable inside jit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def accuracy(output: jnp.ndarray, target: jnp.ndarray, topk=(1,)):
+    """Precision@k for each k, in percent (reference scale)."""
+    maxk = max(topk)
+    # [B, maxk] indices of top-k logits
+    top_idx = jnp.argsort(output, axis=-1)[:, ::-1][:, :maxk]
+    correct = top_idx == target[:, None]
+    res = []
+    for k in topk:
+        res.append(100.0 * jnp.mean(jnp.any(correct[:, :k], axis=-1)))
+    return res
+
+
+def top1_accuracy(output: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    return accuracy(output, target, topk=(1,))[0]

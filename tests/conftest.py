@@ -1,0 +1,22 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX import.
+
+Multi-chip sharding logic is validated on fake XLA CPU devices (the strategy
+the reference could not have: it has no tests at all — SURVEY.md section 4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)
+
+
+def pytest_report_header(config):
+    return f"jax {jax.__version__}, devices: {jax.device_count()} ({jax.devices()[0].platform})"
