@@ -1,0 +1,76 @@
+"""Byzantine attack registry.
+
+The reference resolves attacks by convention-based dynamic import:
+``"xyz" -> blades.attackers.xyzclient.XyzClient``
+(``src/blades/simulator.py:118-133``), shipping noise, labelflipping,
+signflipping, alie, ipm. All those names resolve here, plus minmax/minsum
+(AGR-tailored attacks from the same literature family).
+
+TPU-native design: an attack is NOT a client object with host callbacks — it
+is a set of *pure functions* hooked into the single jitted round program
+(SURVEY.md section 7 step 4):
+
+  * ``on_batch``    — corrupt (x, y) inside the vmapped train step, gated by a
+                      per-client byzantine flag (reference:
+                      ``on_train_batch_begin``, ``client.py:178-193``).
+  * ``on_grads``    — corrupt per-step gradients (reference: signflipping's
+                      overridden ``local_training``).
+  * ``on_updates``  — rewrite rows of the on-device ``[K, D]`` update matrix
+                      after local training; omniscient attacks read the honest
+                      rows for free since everything is one array (reference:
+                      ``omniscient_callback`` host round-trip,
+                      ``simulator.py:239-241``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Type, Union
+
+from blades_tpu.attackers.base import Attack, NoAttack
+from blades_tpu.attackers.noise import Noise
+from blades_tpu.attackers.labelflipping import Labelflipping
+from blades_tpu.attackers.signflipping import Signflipping
+from blades_tpu.attackers.alie import Alie
+from blades_tpu.attackers.ipm import Ipm
+from blades_tpu.attackers.minmax import Minmax, Minsum
+
+ATTACKS: Dict[str, Type[Attack]] = {
+    "noise": Noise,
+    "labelflipping": Labelflipping,
+    "signflipping": Signflipping,
+    "alie": Alie,
+    "ipm": Ipm,
+    "minmax": Minmax,
+    "minsum": Minsum,
+}
+
+
+def get_attack(name: Union[str, Attack, None], **kwargs) -> Attack:
+    """Resolve an attack by registry name (reference naming parity) or pass
+    through a custom :class:`Attack` instance."""
+    if name is None:
+        return NoAttack()
+    if isinstance(name, Attack):
+        return name
+    try:
+        cls = ATTACKS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown attack {name!r}; available: {sorted(ATTACKS)}"
+        ) from None
+    return cls(**kwargs)
+
+
+__all__ = [
+    "Attack",
+    "NoAttack",
+    "Noise",
+    "Labelflipping",
+    "Signflipping",
+    "Alie",
+    "Ipm",
+    "Minmax",
+    "Minsum",
+    "ATTACKS",
+    "get_attack",
+]

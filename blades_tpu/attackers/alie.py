@@ -1,0 +1,50 @@
+"""ALIE ("A Little Is Enough") omniscient attack.
+
+Reference: ``AlieClient`` (``src/blades/attackers/alieclient.py:8-37``):
+z_max = ``norm.ppf((n - f - s) / (n - f))`` with ``s = floor(n/2 + 1) - f``;
+each byzantine row becomes ``mu - z_max * std`` where mu/std are per-coordinate
+moments over the *honest* updates. The ppf is resolved at construction (static
+Python float), so the attack itself is two masked reductions plus a where —
+no host round-trip, unlike the reference's per-round ``omniscient_callback``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+from scipy.stats import norm
+
+from blades_tpu.attackers.base import Attack, honest_stats
+
+
+class Alie(Attack):
+    def __init__(
+        self,
+        num_clients: Optional[int] = None,
+        num_byzantine: Optional[int] = None,
+        z: Optional[float] = None,
+    ):
+        self.num_clients = num_clients
+        self.num_byzantine = num_byzantine
+        self._z = z
+
+    def _z_max(self, n: int, f: int) -> float:
+        if self._z is not None:
+            return float(self._z)
+        s = math.floor(n / 2 + 1) - f
+        cdf_value = (n - f - s) / (n - f)
+        return float(norm.ppf(cdf_value))
+
+    def on_updates(self, updates, byz_mask, key, state=()):
+        n = self.num_clients if self.num_clients is not None else updates.shape[0]
+        f = (
+            self.num_byzantine
+            if self.num_byzantine is not None
+            else int(byz_mask.sum())  # only reachable outside jit
+        )
+        z_max = self._z_max(int(n), int(f))
+        mu, std, _ = honest_stats(updates, byz_mask)
+        malicious = mu - z_max * std
+        return jnp.where(byz_mask[:, None], malicious[None, :], updates), state

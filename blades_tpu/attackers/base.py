@@ -1,0 +1,87 @@
+"""Attack base class: three pure hooks into the jitted round program.
+
+Reference counterpart: ``ByzantineClient`` (``src/blades/client.py:231-253``),
+whose subclasses override host-side lifecycle callbacks. Here each hook is a
+pure function traced into XLA; the byzantine population is a boolean mask over
+the client axis, so honest and byzantine clients run the *same* compiled
+program (no divergent Python control flow, which is what makes the round a
+single ``vmap``-able computation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Attack:
+    """Base class for Byzantine attacks (all hooks default to identity).
+
+    Hyperparameters are plain Python attributes (static under jit). Hooks:
+
+    ``on_batch(x, y, is_byz, num_classes, key)``
+        Per-train-step data corruption inside the vmapped client step.
+        ``is_byz`` is a scalar bool for the current client (under vmap).
+
+    ``on_grads(grads, is_byz)``
+        Per-step gradient corruption (pytree in, pytree out).
+
+    ``on_updates(updates, byz_mask, key, state)``
+        Post-training rewrite of the ``[K, D]`` update matrix. ``byz_mask`` is
+        a ``[K]`` bool vector. Returns ``(updates, new_state)``.
+    """
+
+    #: True if any hook other than on_updates is non-trivial (lets the engine
+    #: skip dead code in the compiled program).
+    trains_dishonestly: bool = False
+
+    def init_state(self, num_clients: int, dim: int) -> Any:
+        return ()
+
+    def on_batch(
+        self,
+        x: jnp.ndarray,
+        y: jnp.ndarray,
+        is_byz: jnp.ndarray,
+        *,
+        num_classes: int,
+        key: jax.Array,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return x, y
+
+    def on_grads(self, grads: Any, is_byz: jnp.ndarray) -> Any:
+        return grads
+
+    def on_updates(
+        self,
+        updates: jnp.ndarray,
+        byz_mask: jnp.ndarray,
+        key: jax.Array,
+        state: Any = (),
+    ) -> Tuple[jnp.ndarray, Any]:
+        return updates, state
+
+    def __repr__(self) -> str:
+        return type(self).__name__
+
+
+class NoAttack(Attack):
+    """All clients honest (reference: ``attack=None`` forces
+    ``num_byzantine=0``, ``simulator.py:118-121``)."""
+
+
+def honest_stats(updates: jnp.ndarray, byz_mask: jnp.ndarray):
+    """Masked per-coordinate mean and unbiased std over honest rows.
+
+    Omniscient attacks (ALIE/IPM/minmax) need moments of the honest updates;
+    with everything resident in one ``[K, D]`` device array this is two masked
+    reductions instead of the reference's host-side loop over client objects
+    (``alieclient.py:25-36``). Unbiased (ddof=1) std matches ``torch.std``.
+    """
+    honest = (~byz_mask).astype(updates.dtype)[:, None]
+    n = jnp.maximum(honest.sum(), 1.0)
+    mu = (updates * honest).sum(axis=0) / n
+    var = ((updates - mu) ** 2 * honest).sum(axis=0) / jnp.maximum(n - 1.0, 1.0)
+    return mu, jnp.sqrt(var), n

@@ -1,0 +1,22 @@
+"""IPM (Inner Product Manipulation) omniscient attack.
+
+Reference: ``IpmClient`` (``src/blades/attackers/ipmclient.py:4-16``): every
+byzantine row becomes ``-epsilon * mean(honest updates)``. One masked
+reduction + where on the device-resident update matrix.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blades_tpu.attackers.base import Attack, honest_stats
+
+
+class Ipm(Attack):
+    def __init__(self, epsilon: float = 0.5):
+        self.epsilon = float(epsilon)
+
+    def on_updates(self, updates, byz_mask, key, state=()):
+        mu, _, _ = honest_stats(updates, byz_mask)
+        malicious = -self.epsilon * mu
+        return jnp.where(byz_mask[:, None], malicious[None, :], updates), state

@@ -1,0 +1,24 @@
+"""Label-flipping attack: ``y -> num_classes - 1 - y`` on byzantine clients.
+
+Reference: ``LabelflippingClient.on_train_batch_begin``
+(``src/blades/attackers/labelflippingclient.py:12-26``). Here the flip is a
+``jnp.where`` gated by the per-client byzantine flag inside the vmapped train
+step, so honest and byzantine clients share one compiled program.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from blades_tpu.attackers.base import Attack
+
+
+class Labelflipping(Attack):
+    trains_dishonestly = True
+
+    def __init__(self, num_classes: int = 10):
+        self.num_classes = int(num_classes)
+
+    def on_batch(self, x, y, is_byz, *, num_classes, key):
+        n = num_classes or self.num_classes
+        return x, jnp.where(is_byz, n - 1 - y, y)

@@ -1,0 +1,69 @@
+"""Min-Max / Min-Sum AGR-agnostic attacks (Shejwalkar & Houmansadr, NDSS'21).
+
+Not in the reference's shipped five, but standard companions in the Byzantine
+literature the reference targets; included for a superset of attack coverage.
+Each byzantine row becomes ``mu + gamma * dev`` where ``dev`` is a unit
+perturbation direction (negative std direction, as in the paper's "std"
+variant) and ``gamma`` is the largest scale keeping the malicious update
+within the honest updates' pairwise-distance envelope:
+
+  * minmax: max distance from malicious to any honest update <= max pairwise
+    honest distance.
+  * minsum: sum of squared distances from malicious to honest updates <= max
+    over honest i of sum_j ||u_i - u_j||^2.
+
+The gamma search is a fixed-iteration bisection under ``lax.fori_loop`` —
+compiler-friendly static control flow instead of the reference's data-driven
+Python loops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from blades_tpu.attackers.base import Attack, honest_stats
+from blades_tpu.ops.distances import pairwise_sq_euclidean
+
+
+class _GammaScaled(Attack):
+    n_bisect: int = 20
+    gamma_init: float = 10.0
+
+    def _objective(self, malicious, updates, honest_w, sq_dists):
+        raise NotImplementedError
+
+    def on_updates(self, updates, byz_mask, key, state=()):
+        mu, std, _ = honest_stats(updates, byz_mask)
+        dev = -std  # "std" perturbation variant
+        honest_w = (~byz_mask).astype(updates.dtype)
+        sq = pairwise_sq_euclidean(updates)
+        # mask non-honest rows/cols out of the envelope statistics
+        pair_mask = honest_w[:, None] * honest_w[None, :]
+        sq = sq * pair_mask
+
+        def feasible(gamma):
+            return self._objective(mu + gamma * dev, updates, honest_w, sq)
+
+        def body(_, carry):
+            gamma, step = carry
+            ok = feasible(gamma)
+            gamma = jnp.where(ok, gamma + step, gamma - step)
+            return gamma, step / 2.0
+
+        gamma0 = jnp.asarray(self.gamma_init, updates.dtype)
+        gamma, _ = lax.fori_loop(0, self.n_bisect, body, (gamma0, gamma0 / 2.0))
+        malicious = mu + gamma * dev
+        return jnp.where(byz_mask[:, None], malicious[None, :], updates), state
+
+
+class Minmax(_GammaScaled):
+    def _objective(self, malicious, updates, honest_w, sq):
+        d = ((updates - malicious[None, :]) ** 2).sum(axis=1) * honest_w
+        return d.max() <= sq.max()
+
+
+class Minsum(_GammaScaled):
+    def _objective(self, malicious, updates, honest_w, sq):
+        d = (((updates - malicious[None, :]) ** 2).sum(axis=1) * honest_w).sum()
+        return d <= sq.sum(axis=1).max()

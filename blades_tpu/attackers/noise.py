@@ -1,0 +1,26 @@
+"""Noise attack: byzantine rows replaced by i.i.d. Gaussian noise.
+
+Reference: ``NoiseClient`` (``src/blades/attackers/noiseclient.py:8-25``)
+uploads ``Normal(mean=0.1, std=0.1)`` of the update's shape from
+``omniscient_callback``. Here it is a single masked ``jnp.where`` on the
+update matrix.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from blades_tpu.attackers.base import Attack
+
+
+class Noise(Attack):
+    def __init__(self, mean: float = 0.1, std: float = 0.1):
+        self.mean = float(mean)
+        self.std = float(std)
+
+    def on_updates(self, updates, byz_mask, key, state=()):
+        noise = self.mean + self.std * jax.random.normal(
+            key, updates.shape, updates.dtype
+        )
+        return jnp.where(byz_mask[:, None], noise, updates), state

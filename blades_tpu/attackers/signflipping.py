@@ -1,0 +1,22 @@
+"""Sign-flipping attack: byzantine clients negate every gradient step.
+
+Reference: ``SignflippingClient.local_training``
+(``src/blades/attackers/signflippingclient.py:6-20``) re-implements the local
+loop with ``p.grad = -p.grad`` before each optimizer step. Here it is a signed
+scale on the gradient pytree, gated by the per-client flag under vmap.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from blades_tpu.attackers.base import Attack
+
+
+class Signflipping(Attack):
+    trains_dishonestly = True
+
+    def on_grads(self, grads, is_byz):
+        sign = jnp.where(is_byz, -1.0, 1.0)
+        return jax.tree_util.tree_map(lambda g: g * sign.astype(g.dtype), grads)

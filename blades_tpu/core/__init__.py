@@ -1,0 +1,18 @@
+"""Core round engine: one federated round == one jitted XLA program.
+
+Reference counterpart: the ``Simulator.run`` -> ``train_actor`` ->
+``_RayActor.local_training`` call stack (``src/blades/simulator.py:203-247``,
+``actor.py:23-33``), where a round is K serialized Python train loops plus two
+trips through the Ray object store. Here the entire round — vmapped local
+SGD, in-graph attacks, robust aggregation, server step — is a single
+compiled function over device-resident arrays (SURVEY.md section 7).
+"""
+
+from blades_tpu.core.engine import (
+    RoundEngine,
+    RoundState,
+    ClientOptSpec,
+    ServerOptSpec,
+)
+
+__all__ = ["RoundEngine", "RoundState", "ClientOptSpec", "ServerOptSpec"]

@@ -1,0 +1,33 @@
+"""Federated datasets: partitioning + device-resident round sampling.
+
+Reference counterparts: ``BaseDataset`` (download -> normalize -> IID or
+Dirichlet partition -> pickle cache, ``src/blades/datasets/basedataset.py``),
+``FLDataset`` (per-client infinite generators, ``datasets/dataset.py:80-115``),
+concrete ``MNIST``/``CIFAR10`` partitioners.
+
+TPU-native data layout (SURVEY.md section 7 step 1): per-client samples live
+as ONE padded device array ``[K, N_max, ...]`` (uint8 for images — normalize
+on device inside the train step, saving 4x HBM traffic), and a round's
+batches ``[K, S, B, ...]`` are produced by a jitted gather — no Python
+generators, no host round-trips.
+"""
+
+from blades_tpu.datasets.fl import FLDataset
+from blades_tpu.datasets.base import BaseDataset, partition_iid, partition_dirichlet
+from blades_tpu.datasets.synthetic import Synthetic
+from blades_tpu.datasets.mnist import MNIST
+from blades_tpu.datasets.cifar10 import CIFAR10
+from blades_tpu.datasets.cifar100 import CIFAR100
+from blades_tpu.datasets.custom import CustomTensorDataset
+
+__all__ = [
+    "FLDataset",
+    "BaseDataset",
+    "partition_iid",
+    "partition_dirichlet",
+    "Synthetic",
+    "MNIST",
+    "CIFAR10",
+    "CIFAR100",
+    "CustomTensorDataset",
+]

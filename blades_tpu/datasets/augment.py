@@ -1,0 +1,84 @@
+"""JAX-native image augmentations (device-side, jitted at sampling time).
+
+Reference train transforms for CIFAR-10: RandomResizedCrop(32, scale>=0.64),
+RandomHorizontalFlip, RandomErasing(p=0.25)
+(``src/blades/datasets/cifar10.py:33-39``), executed per-sample on the host
+by torchvision. Here the equivalents are pure functions over uint8/float
+arrays vmapped over the sampled round batch — augmentation rides the same
+XLA program as the gather, so the host never touches pixels.
+
+Pad-and-crop replaces RandomResizedCrop: identical receptive-field jitter for
+32x32 inputs without a resample (static shapes; dynamic_slice only).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def random_crop(key: jax.Array, x: jnp.ndarray, padding: int = 4) -> jnp.ndarray:
+    """Pad by ``padding`` (reflect) then take a random HxW crop. x: [H, W, C]."""
+    h, w = x.shape[0], x.shape[1]
+    xp = jnp.pad(
+        x, ((padding, padding), (padding, padding), (0, 0)), mode="reflect"
+    )
+    ky, kx = jax.random.split(key)
+    top = jax.random.randint(ky, (), 0, 2 * padding + 1)
+    left = jax.random.randint(kx, (), 0, 2 * padding + 1)
+    return lax.dynamic_slice(xp, (top, left, 0), (h, w, x.shape[2]))
+
+
+def random_hflip(key: jax.Array, x: jnp.ndarray, p: float = 0.5) -> jnp.ndarray:
+    flip = jax.random.bernoulli(key, p)
+    return jnp.where(flip, x[:, ::-1, :], x)
+
+
+def random_erasing(
+    key: jax.Array,
+    x: jnp.ndarray,
+    p: float = 0.25,
+    area: Tuple[float, float] = (0.02, 0.2),
+) -> jnp.ndarray:
+    """Zero a random rectangle with probability p (torchvision RandomErasing)."""
+    h, w = x.shape[0], x.shape[1]
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    frac = jax.random.uniform(k1, (), minval=area[0], maxval=area[1])
+    # aspect ratio in [0.3, 3.3] as torchvision default
+    log_r = jax.random.uniform(k2, (), minval=jnp.log(0.3), maxval=jnp.log(3.3))
+    r = jnp.exp(log_r)
+    eh = jnp.sqrt(frac * h * w * r).astype(jnp.int32).clip(1, h)
+    ew = jnp.sqrt(frac * h * w / r).astype(jnp.int32).clip(1, w)
+    top = jax.random.randint(k3, (), 0, h)
+    left = jax.random.randint(k4, (), 0, w)
+    rows = jnp.arange(h)[:, None]
+    cols = jnp.arange(w)[None, :]
+    inside = (
+        (rows >= top) & (rows < top + eh) & (cols >= left) & (cols < left + ew)
+    )
+    erase = jax.random.bernoulli(k5, p)
+    mask = inside & erase
+    return jnp.where(mask[:, :, None], jnp.zeros_like(x), x)
+
+
+def cifar_train_transform(key: jax.Array, x: jnp.ndarray) -> jnp.ndarray:
+    """crop + flip + erasing on a single [32, 32, 3] image (any dtype)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = random_crop(k1, x)
+    x = random_hflip(k2, x)
+    x = random_erasing(k3, x)
+    return x
+
+
+def make_normalizer(mean: Tuple[float, ...], std: Tuple[float, ...]):
+    """uint8 [0,255] -> float32 standardized; runs fused on device."""
+    mean_a = jnp.asarray(mean, jnp.float32) * 255.0
+    std_a = jnp.asarray(std, jnp.float32) * 255.0
+
+    def normalize(x: jnp.ndarray) -> jnp.ndarray:
+        return (x.astype(jnp.float32) - mean_a) / std_a
+
+    return normalize

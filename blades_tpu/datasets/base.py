@@ -1,0 +1,172 @@
+"""Dataset partitioning: IID and Dirichlet non-IID, with an on-disk cache.
+
+Reference: ``BaseDataset`` (``src/blades/datasets/basedataset.py:13-115``)
+downloads via torchvision, shuffles, splits IID with ``np.split`` or non-IID
+with per-class Dirichlet(alpha) proportions (``datasets/cifar10.py:73-101``,
+``mnist.py:46-70``), and pickle-caches the partition keyed on its meta
+parameters. Same semantics here, cached as ``.npz``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from blades_tpu.datasets.fl import FLDataset
+
+
+def partition_iid(
+    x: np.ndarray, y: np.ndarray, num_clients: int, seed: int = 0
+) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+    """Shuffle then equal split (reference ``train_iid``: shuffle + np.split)."""
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(x))
+    xs = np.array_split(x[order], num_clients)
+    ys = np.array_split(y[order], num_clients)
+    return list(xs), list(ys)
+
+
+def partition_dirichlet(
+    x: np.ndarray,
+    y: np.ndarray,
+    num_clients: int,
+    alpha: float = 0.1,
+    seed: int = 0,
+    min_size: int = 1,
+) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+    """Per-class Dirichlet(alpha) proportions over clients (reference
+    ``train_noniid`` pattern, ``datasets/cifar10.py:73-101``): for each class,
+    draw p ~ Dir(alpha * 1_K) and deal that class's samples out proportionally.
+    Re-draws until every client has at least ``min_size`` samples."""
+    rng = np.random.RandomState(seed)
+    classes = np.unique(y)
+    for _ in range(100):
+        idx_per_client: List[List[int]] = [[] for _ in range(num_clients)]
+        for c in classes:
+            idx_c = np.where(y == c)[0]
+            rng.shuffle(idx_c)
+            p = rng.dirichlet(np.repeat(alpha, num_clients))
+            cuts = (np.cumsum(p) * len(idx_c)).astype(int)[:-1]
+            for i, part in enumerate(np.split(idx_c, cuts)):
+                idx_per_client[i].extend(part.tolist())
+        sizes = [len(ix) for ix in idx_per_client]
+        if min(sizes) >= min_size:
+            break
+    xs, ys = [], []
+    for ix in idx_per_client:
+        ix = np.asarray(ix, int)
+        rng.shuffle(ix)
+        xs.append(x[ix])
+        ys.append(y[ix])
+    return xs, ys
+
+
+class BaseDataset:
+    """Partitioner base: subclasses provide raw arrays via ``load_raw()``.
+
+    Mirrors the reference's constructor surface
+    (``basedataset.py:13-50``): ``data_root``, ``train_bs`` (recorded for
+    parity; batching happens at round-sampling time), ``num_clients``,
+    ``iid``, ``alpha``, ``seed``, plus a partition cache keyed on those.
+    """
+
+    name: str = "base"
+    num_classes: int = 10
+
+    def __init__(
+        self,
+        data_root: str = "./data",
+        train_bs: int = 32,
+        num_clients: int = 20,
+        iid: bool = True,
+        alpha: float = 0.1,
+        seed: int = 0,
+        cache: bool = True,
+    ):
+        self.data_root = data_root
+        self.train_bs = int(train_bs)
+        self.num_clients = int(num_clients)
+        self.iid = bool(iid)
+        self.alpha = float(alpha)
+        self.seed = int(seed)
+        self.cache = bool(cache)
+        self._fl: Optional[FLDataset] = None
+
+    # -- subclass hooks -------------------------------------------------------
+
+    def load_raw(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Return (train_x, train_y, test_x, test_y) as numpy arrays."""
+        raise NotImplementedError
+
+    def make_transform(self) -> Optional[Callable]:
+        """Jitted per-sample train augmentation ``(key, x) -> x`` or None."""
+        return None
+
+    def make_normalize(self) -> Optional[Callable]:
+        """Device-side cast/normalize ``(x) -> x`` or None."""
+        return None
+
+    # -- cache ----------------------------------------------------------------
+
+    def _cache_path(self) -> str:
+        meta = f"{self.name}-{self.num_clients}-{self.iid}-{self.alpha}-{self.seed}"
+        h = hashlib.md5(meta.encode()).hexdigest()[:10]
+        return os.path.join(self.data_root, f"{self.name}_part_{h}.npz")
+
+    def _partition(self):
+        path = self._cache_path()
+        if self.cache and os.path.exists(path):
+            z = np.load(path, allow_pickle=False)
+            return (
+                z["train_x"],
+                z["train_y"],
+                z["train_counts"],
+                z["test_x"],
+                z["test_y"],
+            )
+        train_x, train_y, test_x, test_y = self.load_raw()
+        if self.iid:
+            xs, ys = partition_iid(train_x, train_y, self.num_clients, self.seed)
+        else:
+            xs, ys = partition_dirichlet(
+                train_x, train_y, self.num_clients, self.alpha, self.seed
+            )
+        counts = np.array([len(a) for a in xs], np.int32)
+        n_max = int(counts.max())
+        px = np.zeros((self.num_clients, n_max) + train_x.shape[1:], train_x.dtype)
+        py = np.zeros((self.num_clients, n_max), train_y.dtype)
+        for i, (a, b) in enumerate(zip(xs, ys)):
+            px[i, : len(a)] = a
+            py[i, : len(b)] = b
+        if self.cache:
+            os.makedirs(self.data_root, exist_ok=True)
+            np.savez_compressed(
+                path,
+                train_x=px,
+                train_y=py,
+                train_counts=counts,
+                test_x=test_x,
+                test_y=test_y,
+            )
+        return px, py, counts, test_x, test_y
+
+    # -- public ---------------------------------------------------------------
+
+    def get_dls(self) -> FLDataset:
+        """Build (or return cached) runtime :class:`FLDataset`. Name kept for
+        reference parity (``basedataset.py:98``)."""
+        if self._fl is None:
+            px, py, counts, test_x, test_y = self._partition()
+            self._fl = FLDataset(
+                px,
+                py,
+                counts,
+                test_x,
+                test_y,
+                transform=self.make_transform(),
+                normalize=self.make_normalize(),
+            )
+        return self._fl

@@ -1,0 +1,71 @@
+"""CIFAR-10 federated partitioner.
+
+Reference: ``CIFAR10`` (``src/blades/datasets/cifar10.py:11-108``):
+torchvision download, train transforms RandomResizedCrop/Flip/Erasing
+(``cifar10.py:33-39``), mean/std normalize, Dirichlet or IID partition.
+Here: python-pickle CIFAR batches loaded from disk, uint8 NHWC on device,
+augmentation + normalization fused into the jitted round sampler
+(``blades_tpu/datasets/augment.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from blades_tpu.datasets.base import BaseDataset
+from blades_tpu.datasets.augment import cifar_train_transform, make_normalizer
+
+CIFAR10_MEAN = (0.4914, 0.4822, 0.4465)
+CIFAR10_STD = (0.2470, 0.2435, 0.2616)
+
+
+def _load_batch(path: str) -> tuple:
+    with open(path, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)  # NHWC
+    y = np.asarray(d.get(b"labels", d.get(b"fine_labels")), np.int32)
+    return x.astype(np.uint8), y
+
+
+class CIFAR10(BaseDataset):
+    name = "cifar10"
+    num_classes = 10
+    _dirname = "cifar-10-batches-py"
+    _train_files = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_file = "test_batch"
+    _tar = "cifar-10-python.tar.gz"
+
+    def _batch_dir(self):
+        for base in (self.data_root, os.path.join(self.data_root, "cifar10")):
+            d = os.path.join(base, self._dirname)
+            if os.path.isdir(d):
+                return d
+            tar = os.path.join(base, self._tar)
+            if os.path.exists(tar):
+                with tarfile.open(tar) as tf:
+                    tf.extractall(base)
+                return d
+        raise FileNotFoundError(
+            f"{self.name} data not found under {self.data_root!r}. Place "
+            f"{self._dirname}/ or {self._tar} there; this build performs no "
+            "network downloads. For offline smoke runs use "
+            "blades_tpu.datasets.Synthetic instead."
+        )
+
+    def load_raw(self):
+        d = self._batch_dir()
+        xs, ys = zip(*(_load_batch(os.path.join(d, f)) for f in self._train_files))
+        train_x = np.concatenate(xs)
+        train_y = np.concatenate(ys)
+        test_x, test_y = _load_batch(os.path.join(d, self._test_file))
+        return train_x, train_y, test_x, test_y
+
+    def make_transform(self):
+        return cifar_train_transform
+
+    def make_normalize(self):
+        return make_normalizer(CIFAR10_MEAN, CIFAR10_STD)

@@ -1,0 +1,26 @@
+"""CIFAR-100 federated partitioner (BASELINE.md config 5 workload).
+
+The reference ships only MNIST/CIFAR-10 partitioners but its ``BaseDataset``
+is dataset-agnostic (``src/blades/datasets/basedataset.py:13-115``);
+CIFAR-100 follows the same python-pickle format with ``fine_labels``.
+"""
+
+from __future__ import annotations
+
+from blades_tpu.datasets.cifar10 import CIFAR10
+from blades_tpu.datasets.augment import make_normalizer
+
+CIFAR100_MEAN = (0.5071, 0.4865, 0.4409)
+CIFAR100_STD = (0.2673, 0.2564, 0.2762)
+
+
+class CIFAR100(CIFAR10):
+    name = "cifar100"
+    num_classes = 100
+    _dirname = "cifar-100-python"
+    _train_files = ["train"]
+    _test_file = "test"
+    _tar = "cifar-100-python.tar.gz"
+
+    def make_normalize(self):
+        return make_normalizer(CIFAR100_MEAN, CIFAR100_STD)

@@ -1,0 +1,145 @@
+"""FLDataset: the runtime federated dataset.
+
+Reference: ``FLDataset`` (``src/blades/datasets/dataset.py:80-115``) holds a
+dict of per-client infinite train generators and test sets;
+``get_train_data(uid, n)`` pulls n batches on the host. Here all K clients'
+train data is one padded array family on device and a round's worth of
+batches for ALL clients comes from a single jitted sampler.
+
+Sampling semantics: the reference's infinite generators do
+without-replacement epochs with reshuffle-on-wraparound
+(``basedataset.py:58-86``). We reproduce that per round via the
+uniform-argsort trick: draw a fresh without-replacement permutation of each
+client's samples each round and index it modulo the client's sample count
+(wraparound). Every round is a pure function of (seed, round).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FLDataset:
+    """Device-resident federated dataset.
+
+    Parameters
+    ----------
+    train_x, train_y : per-client padded arrays ``[K, N_max, ...]`` / ``[K, N_max]``.
+    train_counts : ``[K]`` true sample counts (padding is never sampled).
+    test_x, test_y : union test set arrays.
+    transform : optional jitted per-batch augmentation
+        ``(key, x[B, ...]) -> x[B, ...]`` applied at sampling time.
+    normalize : optional ``(x) -> x`` cast/normalize applied after transform
+        (images are stored uint8; normalization runs on device).
+    """
+
+    def __init__(
+        self,
+        train_x: np.ndarray,
+        train_y: np.ndarray,
+        train_counts: np.ndarray,
+        test_x: np.ndarray,
+        test_y: np.ndarray,
+        transform: Optional[Callable] = None,
+        normalize: Optional[Callable] = None,
+        client_ids: Optional[List] = None,
+    ):
+        self.train_x = jnp.asarray(train_x)
+        self.train_y = jnp.asarray(train_y)
+        self.train_counts = jnp.asarray(train_counts, jnp.int32)
+        self.test_x_raw = jnp.asarray(test_x)
+        self.test_y = jnp.asarray(test_y)
+        self.transform = transform
+        self.normalize = normalize
+        self.num_clients = int(self.train_x.shape[0])
+        self.client_ids = (
+            list(client_ids) if client_ids is not None else list(range(self.num_clients))
+        )
+        self._sample_jit: Dict[Tuple[int, int], Callable] = {}
+
+    # -- reference-API parity -------------------------------------------------
+
+    def get_clients(self) -> List:
+        """Client ids (reference: ``FLDataset.get_clients``)."""
+        return self.client_ids
+
+    @property
+    def test_x(self) -> jnp.ndarray:
+        x = self.test_x_raw
+        return self.normalize(x) if self.normalize is not None else x
+
+    # -- round sampling -------------------------------------------------------
+
+    def _build_sampler(self, local_steps: int, batch_size: int) -> Callable:
+        n_max = int(self.train_x.shape[1])
+        need = local_steps * batch_size
+
+        @jax.jit
+        def sample(key: jax.Array):
+            ku, kt = jax.random.split(key)
+            # fresh without-replacement order per client; padding pushed to the
+            # end with +inf so it is never selected before real samples
+            u = jax.random.uniform(ku, (self.num_clients, n_max))
+            pad = (jnp.arange(n_max)[None, :] >= self.train_counts[:, None])
+            order = jnp.argsort(jnp.where(pad, jnp.inf, u), axis=1)
+            pos = jnp.arange(need)[None, :] % jnp.maximum(
+                self.train_counts[:, None], 1
+            )  # wraparound past one local epoch
+            idx = jnp.take_along_axis(order, pos, axis=1)  # [K, S*B]
+
+            cx = jnp.take_along_axis(
+                self.train_x,
+                idx.reshape(idx.shape + (1,) * (self.train_x.ndim - 2)),
+                axis=1,
+            )
+            cy = jnp.take_along_axis(self.train_y, idx, axis=1)
+            if self.transform is not None:
+                flat = cx.reshape((-1,) + cx.shape[2:])
+                tkeys = jax.random.split(kt, flat.shape[0])
+                flat = jax.vmap(self.transform)(tkeys, flat)
+                cx = flat.reshape(cx.shape[:2] + flat.shape[1:])
+            if self.normalize is not None:
+                cx = self.normalize(cx)
+            cx = cx.reshape(
+                (self.num_clients, local_steps, batch_size) + cx.shape[2:]
+            )
+            cy = cy.reshape(self.num_clients, local_steps, batch_size)
+            return cx, cy
+
+        return sample
+
+    def sample_round(
+        self, key: jax.Array, local_steps: int, batch_size: int
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """``[K, S, B, ...]`` train batches for every client, in one gather."""
+        sig = (local_steps, batch_size)
+        if sig not in self._sample_jit:
+            self._sample_jit[sig] = self._build_sampler(local_steps, batch_size)
+        return self._sample_jit[sig](key)
+
+    # -- construction from per-client lists -----------------------------------
+
+    @staticmethod
+    def from_client_arrays(
+        xs: List[np.ndarray],
+        ys: List[np.ndarray],
+        test_x: np.ndarray,
+        test_y: np.ndarray,
+        **kwargs,
+    ) -> "FLDataset":
+        """Build from ragged per-client arrays by padding to ``N_max``."""
+        k = len(xs)
+        counts = np.array([len(x) for x in xs], np.int32)
+        n_max = int(counts.max())
+        sample_shape = xs[0].shape[1:]
+        train_x = np.zeros((k, n_max) + sample_shape, xs[0].dtype)
+        train_y = np.zeros((k, n_max), ys[0].dtype)
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            train_x[i, : len(x)] = x
+            train_y[i, : len(y)] = y
+        return FLDataset(train_x, train_y, counts, test_x, test_y, **kwargs)

@@ -1,0 +1,78 @@
+"""MNIST federated partitioner.
+
+Reference: ``MNIST`` (``src/blades/datasets/mnist.py:10-80``): torchvision
+download, mean/std normalize (0.1307/0.3081), IID or Dirichlet partition.
+Images are stored uint8 ``[N, 28, 28, 1]`` (NHWC, the TPU-friendly layout);
+normalization happens on device at sampling time.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from blades_tpu.datasets.base import BaseDataset
+from blades_tpu.datasets.augment import make_normalizer
+
+
+def _read_idx_images(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad magic {magic} in {path}"
+        return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols, 1)
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad magic {magic} in {path}"
+        return np.frombuffer(f.read(), np.uint8).astype(np.int32)
+
+
+class MNIST(BaseDataset):
+    name = "mnist"
+    num_classes = 10
+
+    def load_raw(self):
+        # Look for the standard IDX files (raw or .gz) under data_root; also
+        # accept a torchvision-style MNIST/raw subdir or a prepared .npz.
+        npz = os.path.join(self.data_root, "mnist.npz")
+        if os.path.exists(npz):
+            z = np.load(npz)
+            return (
+                z["train_x"].reshape(-1, 28, 28, 1).astype(np.uint8),
+                z["train_y"].astype(np.int32),
+                z["test_x"].reshape(-1, 28, 28, 1).astype(np.uint8),
+                z["test_y"].astype(np.int32),
+            )
+        for sub in ("", "MNIST/raw"):
+            d = os.path.join(self.data_root, sub)
+            for ext in ("", ".gz"):
+                p = os.path.join(d, "train-images-idx3-ubyte" + ext)
+                if os.path.exists(p):
+                    return (
+                        _read_idx_images(p),
+                        _read_idx_labels(
+                            os.path.join(d, "train-labels-idx1-ubyte" + ext)
+                        ),
+                        _read_idx_images(
+                            os.path.join(d, "t10k-images-idx3-ubyte" + ext)
+                        ),
+                        _read_idx_labels(
+                            os.path.join(d, "t10k-labels-idx1-ubyte" + ext)
+                        ),
+                    )
+        raise FileNotFoundError(
+            f"MNIST data not found under {self.data_root!r}. Place the IDX "
+            "files (train-images-idx3-ubyte[.gz], ...) or mnist.npz there; "
+            "this build performs no network downloads. For offline smoke "
+            "runs use blades_tpu.datasets.Synthetic instead."
+        )
+
+    def make_normalize(self):
+        return make_normalizer((0.1307,), (0.3081,))

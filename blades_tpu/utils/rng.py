@@ -31,6 +31,7 @@ EVAL = 4
 # Client streams branch through a dedicated tag first so that
 # fold_in(round_key, client_id) can never collide with a purpose stream.
 CLIENTS = 5
+AGG = 6
 
 
 def key_for_round(seed_key: jax.Array, round_idx) -> jax.Array:

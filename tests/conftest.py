@@ -15,6 +15,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# The axon TPU plugin's sitecustomize forces jax_platforms="axon,cpu" at
+# interpreter start, overriding the env var — override it back after import.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 
