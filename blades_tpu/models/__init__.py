@@ -1,0 +1,78 @@
+"""Model zoo (flax.linen, NHWC, TPU-first).
+
+Reference counterparts (``src/blades/models/``): MNIST ``MLP``
+(``mnist/dnn.py:5-23``), CIFAR-10 Compact-Transformer zoo — ``CCT``
+(``cifar10/cctnets/cct.py:33``), ``CVT`` (``cvt.py:17``), ``ViTLite``
+(``vit.py:17``) — vendored from SHI-Labs Compact-Transformers. ResNet-18 and
+WideResNet-28-10 cover the BASELINE.md workloads (configs 2-5). GroupNorm
+replaces BatchNorm in the resnets: running statistics are cross-batch mutable
+state that breaks the pure-functional vmapped client step and is known-bad
+under non-IID federated data; GroupNorm is the standard FL substitution and
+keeps every model a pure ``params -> logits`` function.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from blades_tpu.models.common import ModelSpec, build_fns
+from blades_tpu.models.mlp import MLP, create_mnist_model
+from blades_tpu.models.cct import (
+    CCT,
+    cct_2_3x2_32,
+    cct_4_3x2_32,
+    cct_6_3x1_32,
+    cct_7_3x1_32,
+    cvt_7_4_32,
+    vit_lite_7_4_32,
+    CCTNet,
+)
+from blades_tpu.models.resnet import ResNet18, ResNet34
+from blades_tpu.models.wrn import WideResNet, wrn_28_10
+
+MODELS: Dict[str, Callable] = {
+    "mlp": lambda num_classes=10, **kw: MLP(num_classes=num_classes),
+    "cct": lambda num_classes=10, **kw: cct_2_3x2_32(num_classes=num_classes),
+    "cctnet": lambda num_classes=10, **kw: cct_2_3x2_32(num_classes=num_classes),
+    "cct_2_3x2_32": cct_2_3x2_32,
+    "cct_4_3x2_32": cct_4_3x2_32,
+    "cct_6_3x1_32": cct_6_3x1_32,
+    "cct_7_3x1_32": cct_7_3x1_32,
+    "cvt_7_4_32": cvt_7_4_32,
+    "vit_lite_7_4_32": vit_lite_7_4_32,
+    "resnet18": lambda num_classes=10, **kw: ResNet18(num_classes=num_classes),
+    "resnet34": lambda num_classes=10, **kw: ResNet34(num_classes=num_classes),
+    "wrn_28_10": wrn_28_10,
+}
+
+
+def create_model(name: str, num_classes: int = 10, **kwargs):
+    """Resolve a model by name (reference: per-dataset ``create_model()``
+    factories, e.g. ``models/mnist/dnn.py:22``)."""
+    try:
+        factory = MODELS[name]
+    except KeyError:
+        raise ValueError(f"Unknown model {name!r}; available: {sorted(MODELS)}") from None
+    return factory(num_classes=num_classes, **kwargs)
+
+
+__all__ = [
+    "ModelSpec",
+    "build_fns",
+    "create_model",
+    "MODELS",
+    "MLP",
+    "create_mnist_model",
+    "CCT",
+    "CCTNet",
+    "cct_2_3x2_32",
+    "cct_4_3x2_32",
+    "cct_6_3x1_32",
+    "cct_7_3x1_32",
+    "cvt_7_4_32",
+    "vit_lite_7_4_32",
+    "ResNet18",
+    "ResNet34",
+    "WideResNet",
+    "wrn_28_10",
+]

@@ -1,0 +1,341 @@
+"""Compact Transformers (CCT / CVT / ViT-Lite) in flax.
+
+Reference: vendored SHI-Labs Compact-Transformers
+(``src/blades/models/cifar10/cctnets/``): conv ``Tokenizer``
+(``utils/tokenizer.py:6``), pre-norm ``TransformerEncoderLayer`` with
+stochastic depth (``utils/transformers.py:76-103``), ``TransformerClassifier``
+with sequence pooling (``utils/transformers.py:134-216``). The flagship config
+is ``cct_2_3x2_32`` — 2 encoder layers, dim 128, 2 heads, mlp_ratio 1, 3x3
+conv tokenizer x2 — wrapped as ``CCTNet``
+(``src/blades/models/cifar10/cct.py:6-16``, ~284K params).
+
+TPU notes: NHWC layout, all matmuls MXU-shaped; attention over <=64 tokens is
+a single fused softmax(QK^T)V — no flash/ring machinery needed at this
+sequence length (SURVEY.md section 5, "long-context: absent by design").
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from blades_tpu.models.common import DropPath
+
+_trunc02 = nn.initializers.truncated_normal(stddev=0.02)
+_he = nn.initializers.kaiming_normal()
+
+
+class Tokenizer(nn.Module):
+    """Conv tokenizer (reference ``utils/tokenizer.py:6-49``): n conv layers
+    (ReLU + 3x3/2 maxpool for CCT; a single patchify conv for CVT/ViT-Lite),
+    flattened to a token sequence."""
+
+    kernel_size: int
+    stride: int
+    padding: int
+    n_conv_layers: int = 1
+    n_output_channels: int = 64
+    in_planes: int = 64
+    max_pool: bool = True
+    use_act: bool = True
+    pooling_kernel_size: int = 3
+    pooling_stride: int = 2
+    pooling_padding: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        filters = [self.in_planes] * (self.n_conv_layers - 1) + [
+            self.n_output_channels
+        ]
+        for f in filters:
+            x = nn.Conv(
+                f,
+                (self.kernel_size, self.kernel_size),
+                strides=(self.stride, self.stride),
+                padding=[(self.padding, self.padding)] * 2,
+                use_bias=False,
+                kernel_init=_he,
+            )(x)
+            if self.use_act:
+                x = nn.relu(x)
+            if self.max_pool:
+                x = nn.max_pool(
+                    x,
+                    (self.pooling_kernel_size,) * 2,
+                    strides=(self.pooling_stride,) * 2,
+                    padding=[(self.pooling_padding,) * 2] * 2,
+                )
+        return x.reshape(x.shape[0], -1, x.shape[-1])  # [B, N, C]
+
+    def sequence_length(self, height: int, width: int, channels: int = 3) -> int:
+        n = height
+        for _ in range(self.n_conv_layers):
+            n = (n + 2 * self.padding - self.kernel_size) // self.stride + 1
+            if self.max_pool:
+                n = (
+                    n + 2 * self.pooling_padding - self.pooling_kernel_size
+                ) // self.pooling_stride + 1
+        return n * n
+
+
+class Attention(nn.Module):
+    """MHSA (reference ``utils/transformers.py:8-37``): qkv without bias,
+    projection with bias, attention + projection dropout."""
+
+    dim: int
+    num_heads: int
+    attention_dropout: float = 0.1
+    projection_dropout: float = 0.1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        b, n, c = x.shape
+        head_dim = self.dim // self.num_heads
+        qkv = nn.Dense(self.dim * 3, use_bias=False, kernel_init=_trunc02)(x)
+        qkv = qkv.reshape(b, n, 3, self.num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B, N, H, Dh]
+        attn = jnp.einsum("bnhd,bmhd->bhnm", q, k) * (head_dim**-0.5)
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = nn.Dropout(self.attention_dropout)(attn, deterministic=deterministic)
+        out = jnp.einsum("bhnm,bmhd->bnhd", attn, v).reshape(b, n, c)
+        out = nn.Dense(self.dim, kernel_init=_trunc02)(out)
+        return nn.Dropout(self.projection_dropout)(out, deterministic=deterministic)
+
+
+class TransformerEncoderLayer(nn.Module):
+    """Pre-norm block with the reference's exact residual wiring
+    (``utils/transformers.py:99-103``): attn residual, then LayerNorm, then an
+    MLP residual branching off the *normed* stream."""
+
+    d_model: int
+    nhead: int
+    dim_feedforward: int
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    drop_path_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        h = Attention(
+            self.d_model,
+            self.nhead,
+            self.attention_dropout,
+            self.dropout,
+        )(nn.LayerNorm()(x), deterministic=deterministic)
+        x = x + DropPath(self.drop_path_rate)(h, deterministic=deterministic)
+        x = nn.LayerNorm()(x)
+        h = nn.Dense(self.dim_feedforward, kernel_init=_trunc02)(x)
+        h = nn.Dropout(self.dropout)(nn.gelu(h), deterministic=deterministic)
+        h = nn.Dense(self.d_model, kernel_init=_trunc02)(h)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        return x + DropPath(self.drop_path_rate)(h, deterministic=deterministic)
+
+
+def sinusoidal_embedding(n: int, dim: int) -> jnp.ndarray:
+    pos = jnp.arange(n)[:, None]
+    i = jnp.arange(dim)[None, :]
+    angle = pos / jnp.power(10000.0, 2 * (i // 2) / dim)
+    pe = jnp.where(i % 2 == 0, jnp.sin(angle), jnp.cos(angle))
+    return pe[None]
+
+
+class CCT(nn.Module):
+    """Compact Convolutional Transformer (reference ``cctnets/cct.py:33-88``).
+
+    ``seq_pool=True`` -> attention sequence pooling; ``False`` -> class token
+    (ViT-Lite mode). The tokenizer style (conv stack vs patchify) is what
+    distinguishes CCT from CVT/ViT-Lite.
+    """
+
+    num_classes: int = 10
+    img_size: int = 32
+    in_channels: int = 3
+    embedding_dim: int = 128
+    num_layers: int = 2
+    num_heads: int = 2
+    mlp_ratio: float = 1.0
+    kernel_size: int = 3
+    stride: Optional[int] = None
+    padding: Optional[int] = None
+    n_conv_layers: int = 2
+    max_pool: bool = True
+    use_act: bool = True
+    seq_pool: bool = True
+    dropout: float = 0.0
+    attention_dropout: float = 0.1
+    stochastic_depth: float = 0.1
+    positional_embedding: str = "learnable"  # learnable | sine | none
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        det = not train
+        stride = (
+            self.stride
+            if self.stride is not None
+            else max(1, (self.kernel_size // 2) - 1)
+        )
+        padding = (
+            self.padding if self.padding is not None else max(1, self.kernel_size // 2)
+        )
+        tokenizer = Tokenizer(
+            kernel_size=self.kernel_size,
+            stride=stride,
+            padding=padding,
+            n_conv_layers=self.n_conv_layers,
+            n_output_channels=self.embedding_dim,
+            in_planes=64,
+            max_pool=self.max_pool,
+            use_act=self.use_act,
+        )
+        x = tokenizer(x)
+        seq_len = x.shape[1]
+
+        if not self.seq_pool:
+            cls = self.param(
+                "class_emb", nn.initializers.zeros, (1, 1, self.embedding_dim)
+            )
+            x = jnp.concatenate([jnp.tile(cls, (x.shape[0], 1, 1)), x], axis=1)
+            seq_len += 1
+
+        if self.positional_embedding == "learnable":
+            pe = self.param(
+                "positional_emb",
+                nn.initializers.truncated_normal(stddev=0.2),
+                (1, seq_len, self.embedding_dim),
+            )
+            x = x + pe
+        elif self.positional_embedding == "sine":
+            x = x + sinusoidal_embedding(seq_len, self.embedding_dim)
+
+        x = nn.Dropout(self.dropout)(x, deterministic=det)
+        dpr = [
+            float(r) for r in jnp.linspace(0.0, self.stochastic_depth, self.num_layers)
+        ]
+        for i in range(self.num_layers):
+            x = TransformerEncoderLayer(
+                d_model=self.embedding_dim,
+                nhead=self.num_heads,
+                dim_feedforward=int(self.embedding_dim * self.mlp_ratio),
+                dropout=self.dropout,
+                attention_dropout=self.attention_dropout,
+                drop_path_rate=dpr[i],
+            )(x, deterministic=det)
+        x = nn.LayerNorm()(x)
+
+        if self.seq_pool:
+            # softmax(Wx)^T x over the sequence (utils/transformers.py:209)
+            w = nn.Dense(1, kernel_init=_trunc02)(x)  # [B, N, 1]
+            w = jax.nn.softmax(w, axis=1)
+            x = jnp.einsum("bnl,bnc->bc", w, x)
+        else:
+            x = x[:, 0]
+        return nn.Dense(self.num_classes, kernel_init=_trunc02)(x)
+
+
+# -- variant factories (reference cctnets/cct.py:121-254, cvt.py, vit.py) -----
+
+
+def cct_2_3x2_32(num_classes: int = 10, img_size: int = 32, **kw) -> CCT:
+    return CCT(
+        num_classes=num_classes,
+        img_size=img_size,
+        num_layers=2,
+        num_heads=2,
+        mlp_ratio=1.0,
+        embedding_dim=128,
+        kernel_size=3,
+        n_conv_layers=2,
+        **kw,
+    )
+
+
+def cct_4_3x2_32(num_classes: int = 10, img_size: int = 32, **kw) -> CCT:
+    return CCT(
+        num_classes=num_classes,
+        img_size=img_size,
+        num_layers=4,
+        num_heads=2,
+        mlp_ratio=1.0,
+        embedding_dim=128,
+        kernel_size=3,
+        n_conv_layers=2,
+        **kw,
+    )
+
+
+def cct_6_3x1_32(num_classes: int = 10, img_size: int = 32, **kw) -> CCT:
+    return CCT(
+        num_classes=num_classes,
+        img_size=img_size,
+        num_layers=6,
+        num_heads=4,
+        mlp_ratio=2.0,
+        embedding_dim=256,
+        kernel_size=3,
+        n_conv_layers=1,
+        **kw,
+    )
+
+
+def cct_7_3x1_32(num_classes: int = 10, img_size: int = 32, **kw) -> CCT:
+    return CCT(
+        num_classes=num_classes,
+        img_size=img_size,
+        num_layers=7,
+        num_heads=4,
+        mlp_ratio=2.0,
+        embedding_dim=256,
+        kernel_size=3,
+        n_conv_layers=1,
+        **kw,
+    )
+
+
+def cvt_7_4_32(num_classes: int = 10, img_size: int = 32, **kw) -> CCT:
+    """CVT: patchify tokenizer (4x4 conv, no act/pool) + seq-pool
+    (reference ``cctnets/cvt.py:17-58``)."""
+    return CCT(
+        num_classes=num_classes,
+        img_size=img_size,
+        num_layers=7,
+        num_heads=4,
+        mlp_ratio=2.0,
+        embedding_dim=256,
+        kernel_size=4,
+        stride=4,
+        padding=0,
+        n_conv_layers=1,
+        max_pool=False,
+        use_act=False,
+        seq_pool=True,
+        **kw,
+    )
+
+
+def vit_lite_7_4_32(num_classes: int = 10, img_size: int = 32, **kw) -> CCT:
+    """ViT-Lite: patchify tokenizer + class token instead of seq-pool
+    (reference ``cctnets/vit.py:17-60``)."""
+    return CCT(
+        num_classes=num_classes,
+        img_size=img_size,
+        num_layers=7,
+        num_heads=4,
+        mlp_ratio=2.0,
+        embedding_dim=256,
+        kernel_size=4,
+        stride=4,
+        padding=0,
+        n_conv_layers=1,
+        max_pool=False,
+        use_act=False,
+        seq_pool=False,
+        **kw,
+    )
+
+
+# Reference wrapper-class name parity (src/blades/models/cifar10/cct.py:6-16)
+CCTNet = cct_2_3x2_32

@@ -1,0 +1,95 @@
+"""Shared model plumbing: the flax-module -> pure-function adapter.
+
+The round engine (``blades_tpu/core/engine.py``) consumes two pure functions,
+``train_loss_fn(params, x, y, key)`` and ``eval_logits_fn(params, x)``.
+:func:`build_fns` derives both from any flax module (dropout/droppath keyed by
+``key`` in train mode, deterministic in eval), replacing the reference's
+``model``/``loss_func`` object pair (``src/blades/client.py:100-109``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+def cross_entropy(logits: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy; accepts logits OR log-probs (the reference
+    MNIST MLP outputs log_softmax and is trained with CrossEntropyLoss on it,
+    ``models/mnist/dnn.py:17-19`` — log_softmax is idempotent here so both
+    conventions give identical losses)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    one_hot = jax.nn.one_hot(y, logits.shape[-1], dtype=jnp.float32)
+    return -jnp.mean(jnp.sum(one_hot * logp, axis=-1))
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """Bundle of the pure functions the engine needs, plus init."""
+
+    module: Any
+    init: Callable[[jax.Array], Any]
+    train_loss_fn: Callable
+    eval_logits_fn: Callable
+    param_count: Optional[int] = None
+
+
+def build_fns(
+    module: nn.Module,
+    sample_shape: Tuple[int, ...],
+    loss: str = "crossentropy",
+    param_dtype=jnp.float32,
+) -> ModelSpec:
+    """Adapt a flax module to the engine's pure-function interface.
+
+    ``loss='crossentropy'`` matches the reference's only supported loss
+    (``client.py:100-104`` raises for anything else).
+    """
+    if loss != "crossentropy":
+        raise NotImplementedError(f"loss {loss!r} (reference parity: crossentropy only)")
+
+    def init(key: jax.Array):
+        dummy = jnp.zeros((1,) + tuple(sample_shape), param_dtype)
+        variables = module.init({"params": key}, dummy, train=False)
+        return variables["params"]
+
+    def train_loss_fn(params, x, y, key):
+        logits = module.apply(
+            {"params": params}, x, train=True, rngs={"dropout": key}
+        )
+        return cross_entropy(logits, y)
+
+    def eval_logits_fn(params, x):
+        return module.apply({"params": params}, x, train=False)
+
+    return ModelSpec(
+        module=module,
+        init=init,
+        train_loss_fn=train_loss_fn,
+        eval_logits_fn=eval_logits_fn,
+    )
+
+
+class DropPath(nn.Module):
+    """Per-sample stochastic depth (reference:
+    ``cctnets/utils/stochastic_depth.py:28``): drop a residual branch for a
+    whole sample with probability ``rate``, rescaling survivors."""
+
+    rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        if self.rate == 0.0 or deterministic:
+            return x
+        keep = 1.0 - self.rate
+        rng = self.make_rng("dropout")
+        shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+trunc_normal = nn.initializers.truncated_normal

@@ -1,0 +1,31 @@
+"""MNIST MLP, reference-architecture parity.
+
+Reference: ``MLP`` (``src/blades/models/mnist/dnn.py:5-19``):
+flatten -> 784->64 relu -> 64->128 relu -> 128->10 log_softmax.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from blades_tpu.models.common import build_fns
+
+
+class MLP(nn.Module):
+    num_classes: int = 10
+    hidden: tuple = (64, 128)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = x.reshape(x.shape[0], -1)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        x = nn.Dense(self.num_classes)(x)
+        return nn.log_softmax(x)
+
+
+def create_mnist_model():
+    """Reference ``create_model()`` parity (``dnn.py:22-23``): returns the
+    model spec with crossentropy loss wired."""
+    return build_fns(MLP(), sample_shape=(28, 28, 1))

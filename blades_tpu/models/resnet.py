@@ -1,0 +1,77 @@
+"""ResNet-18/34 (CIFAR variant) in flax, GroupNorm-normalized.
+
+The reference has no resnet (its CIFAR model is CCT), but BASELINE.md
+configs 2-4 specify ResNet-18 as the 100/1000-client CIFAR-10 workload.
+GroupNorm replaces BatchNorm so the model stays a pure ``params -> logits``
+function under the vmapped federated client step (see models/__init__.py).
+CIFAR stem: 3x3 conv, no max-pool.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+_he = nn.initializers.kaiming_normal()
+
+
+def _norm(x: jnp.ndarray, groups: int = 8) -> jnp.ndarray:
+    return nn.GroupNorm(num_groups=min(groups, x.shape[-1]))(x)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = nn.Conv(
+            self.filters, (3, 3), strides=(self.stride, self.stride),
+            padding=[(1, 1), (1, 1)], use_bias=False, kernel_init=_he,
+        )(x)
+        y = nn.relu(_norm(y))
+        y = nn.Conv(
+            self.filters, (3, 3), padding=[(1, 1), (1, 1)],
+            use_bias=False, kernel_init=_he,
+        )(y)
+        y = _norm(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters, (1, 1), strides=(self.stride, self.stride),
+                use_bias=False, kernel_init=_he,
+            )(residual)
+            residual = _norm(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 10
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = nn.Conv(
+            self.width, (3, 3), padding=[(1, 1), (1, 1)],
+            use_bias=False, kernel_init=_he,
+        )(x)
+        x = nn.relu(_norm(x))
+        filters = self.width
+        for stage, blocks in enumerate(self.stage_sizes):
+            for b in range(blocks):
+                stride = 2 if stage > 0 and b == 0 else 1
+                x = BasicBlock(filters, stride)(x)
+            filters *= 2
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def ResNet18(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes, **kw)
+
+
+def ResNet34(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, **kw)

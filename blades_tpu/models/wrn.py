@@ -1,0 +1,71 @@
+"""WideResNet-28-10 in flax, GroupNorm-normalized (BASELINE.md config 5).
+
+Pre-activation wide residual blocks (Zagoruyko & Komodakis). GroupNorm for
+the same pure-function reason as resnet.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+_he = nn.initializers.kaiming_normal()
+
+
+def _norm(x: jnp.ndarray, groups: int = 8) -> jnp.ndarray:
+    return nn.GroupNorm(num_groups=min(groups, x.shape[-1]))(x)
+
+
+class WideBlock(nn.Module):
+    filters: int
+    stride: int = 1
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        y = nn.relu(_norm(x))
+        shortcut = x
+        if x.shape[-1] != self.filters or self.stride != 1:
+            shortcut = nn.Conv(
+                self.filters, (1, 1), strides=(self.stride, self.stride),
+                use_bias=False, kernel_init=_he,
+            )(y)
+        y = nn.Conv(
+            self.filters, (3, 3), strides=(self.stride, self.stride),
+            padding=[(1, 1), (1, 1)], use_bias=False, kernel_init=_he,
+        )(y)
+        y = nn.relu(_norm(y))
+        if self.dropout > 0:
+            y = nn.Dropout(self.dropout)(y, deterministic=not train)
+        y = nn.Conv(
+            self.filters, (3, 3), padding=[(1, 1), (1, 1)],
+            use_bias=False, kernel_init=_he,
+        )(y)
+        return y + shortcut
+
+
+class WideResNet(nn.Module):
+    depth: int = 28
+    widen_factor: int = 10
+    num_classes: int = 10
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        n = (self.depth - 4) // 6
+        widths = [16, 16 * self.widen_factor, 32 * self.widen_factor, 64 * self.widen_factor]
+        x = nn.Conv(
+            widths[0], (3, 3), padding=[(1, 1), (1, 1)],
+            use_bias=False, kernel_init=_he,
+        )(x)
+        for stage in range(3):
+            for b in range(n):
+                stride = 2 if stage > 0 and b == 0 else 1
+                x = WideBlock(widths[stage + 1], stride, self.dropout)(x, train=train)
+        x = nn.relu(_norm(x))
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def wrn_28_10(num_classes: int = 10, **kw) -> WideResNet:
+    return WideResNet(depth=28, widen_factor=10, num_classes=num_classes, **kw)
