@@ -21,8 +21,15 @@ __all__ = ["__version__"]
 
 # Top-level re-exports resolve lazily (PEP 562) so that importing a
 # subpackage (e.g. blades_tpu.aggregators) never pays for the full stack.
-# Names are added here in the same change that ships their module.
-_LAZY = {}
+_LAZY = {
+    "Simulator": ("blades_tpu.simulator", "Simulator"),
+    "BladesClient": ("blades_tpu.client", "BladesClient"),
+    "ByzantineClient": ("blades_tpu.client", "ByzantineClient"),
+    "BladesServer": ("blades_tpu.server", "BladesServer"),
+    "RoundEngine": ("blades_tpu.core", "RoundEngine"),
+    "ClientOptSpec": ("blades_tpu.core", "ClientOptSpec"),
+    "ServerOptSpec": ("blades_tpu.core", "ServerOptSpec"),
+}
 
 
 def __getattr__(name):  # PEP 562 lazy imports keep subpackage imports light

@@ -1,0 +1,88 @@
+"""Client handles: host-side views into the batched on-device population.
+
+Reference counterpart: ``BladesClient``/``ByzantineClient``
+(``src/blades/client.py:12-253``) — stateful objects that own a model copy
+and run train loops. Here a client IS an index into the stacked arrays
+(SURVEY.md section 7 design stance); these handle objects exist for API
+parity (``get_clients``, ``trust``, ``is_byzantine``, ``get_update``) and as
+the registration surface for custom attacks.
+
+Custom attacks: subclass :class:`ByzantineClient` and attach an
+:class:`~blades_tpu.attackers.Attack` (or override ``make_attack``); pass
+instances to ``Simulator.register_attackers`` (reference extension flow:
+``examples/customize_attack.py``, ``simulator.py:167-187``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from blades_tpu.attackers.base import Attack
+
+
+class BladesClient:
+    """Honest client handle."""
+
+    _is_byzantine: bool = False
+
+    def __init__(self, id: Optional[int] = None, device=None):
+        self._id = id
+        self._is_trusted = False
+        self._update = None  # row view of the last round's update matrix
+
+    def id(self):
+        return self._id
+
+    def is_byzantine(self) -> bool:
+        return self._is_byzantine
+
+    def trust(self, trusted: bool = True) -> None:
+        """Mark trusted (consumed by FLTrust; reference ``client.py:71-76``)."""
+        self._is_trusted = bool(trusted)
+
+    def is_trusted(self) -> bool:
+        return self._is_trusted
+
+    def get_update(self) -> Optional[jnp.ndarray]:
+        """Last uploaded update vector (populated by the simulator after each
+        round when update retention is enabled)."""
+        return self._update
+
+    def save_update(self, update: jnp.ndarray) -> None:
+        self._update = update
+
+    def __str__(self) -> str:
+        return "BladesClient"
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(id={self._id})"
+
+
+class ByzantineClient(BladesClient):
+    """Byzantine client handle; carries the attack transform applied to its
+    row(s) of the update matrix inside the jitted round."""
+
+    _is_byzantine = True
+
+    def __init__(self, *args, attack: Optional[Attack] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._attack = attack
+
+    def make_attack(self) -> Optional[Attack]:
+        """Override to supply the attack for this client. Default: the
+        ``attack=`` constructor argument."""
+        return self._attack
+
+    def omniscient_callback(self, updates, byz_mask, key, state=()):
+        """Pure omniscient hook: rewrite the ``[K, D]`` update matrix
+        (reference: host-side ``omniscient_callback(simulator)``,
+        ``client.py:244-253``). Default delegates to the attached attack."""
+        attack = self.make_attack()
+        if attack is None:
+            return updates, state
+        return attack.on_updates(updates, byz_mask, key, state)
+
+    def __str__(self) -> str:
+        return "ByzantineClient"

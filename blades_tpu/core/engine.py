@@ -110,7 +110,9 @@ class RoundState(NamedTuple):
 class RoundMetrics(NamedTuple):
     train_loss: jnp.ndarray  # scalar: mean loss over honest clients
     train_loss_all: jnp.ndarray  # scalar: mean loss over all clients
+    train_top1: jnp.ndarray  # scalar: mean train top-1 over honest clients
     update_variance: jnp.ndarray  # scalar: mean per-coord variance of updates
+    update_variance_norm: jnp.ndarray  # L2 norm of the per-coord variance
     agg_norm: jnp.ndarray  # L2 norm of the aggregated update
 
 
@@ -221,22 +223,25 @@ class RoundEngine:
             )
 
             def clamped_loss(p_):
-                loss = self.train_loss_fn(p_, x, y, bkey)
+                out = self.train_loss_fn(p_, x, y, bkey)
+                loss, aux = out if isinstance(out, tuple) else (out, {})
                 # parity: reference clamps loss to [0, 1e6] to survive
                 # attack-induced blowups (client.py:191)
-                return jnp.clip(loss, 0.0, self.loss_clamp)
+                return jnp.clip(loss, 0.0, self.loss_clamp), aux
 
-            loss, grads = jax.value_and_grad(clamped_loss)(p)
+            (loss, aux), grads = jax.value_and_grad(clamped_loss, has_aux=True)(p)
             grads = self.attack.on_grads(grads, is_byz)
             updates, ost = self._client_tx.update(grads, ost, p)
             p = jax.tree_util.tree_map(
                 lambda a, u: a - lr * u.astype(a.dtype), p, updates
             )
-            return (p, ost, i + 1), loss
+            return (p, ost, i + 1), (loss, aux.get("top1", jnp.nan))
 
-        (pf, ostf, _), losses = lax.scan(step, (params, opt_state, 0), (cx, cy))
+        (pf, ostf, _), (losses, top1s) = lax.scan(
+            step, (params, opt_state, 0), (cx, cy)
+        )
         update = ravel(pf) - flat0
-        return update, ostf, losses.mean()
+        return update, ostf, losses.mean(), top1s.mean()
 
     def _round(self, state: RoundState, cx, cy, client_lr, server_lr, key):
         round_key = rng.key_for_round(key, state.round_idx)
@@ -254,7 +259,7 @@ class RoundEngine:
             in_axes = (None, None, None, 0, 0, 0, 0)
             opt_arg = ()
 
-        updates, new_client_opt, losses = jax.vmap(
+        updates, new_client_opt, losses, top1s = jax.vmap(
             self._local_update, in_axes=in_axes
         )(state.params, opt_arg, client_lr, cx, cy, client_keys, self.byz_mask)
         if not self.client_opt.persist:
@@ -290,10 +295,15 @@ class RoundEngine:
 
         honest = (~self.byz_mask).astype(losses.dtype)
         n_honest = jnp.maximum(honest.sum(), 1.0)
+        # variance stats mirror the reference's log_variance
+        # (simulator.py:309-322): population variance over client updates
+        var = updates.var(axis=0)
         metrics = RoundMetrics(
             train_loss=(losses * honest).sum() / n_honest,
             train_loss_all=losses.mean(),
-            update_variance=updates.var(axis=0).mean(),
+            train_top1=(top1s * honest).sum() / n_honest,
+            update_variance=var.mean(),
+            update_variance_norm=jnp.linalg.norm(var),
             agg_norm=jnp.linalg.norm(agg),
         )
         new_state = RoundState(
@@ -304,7 +314,7 @@ class RoundEngine:
             attack_state=attack_state,
             round_idx=state.round_idx + 1,
         )
-        return new_state, metrics
+        return new_state, metrics, updates
 
     def run_round(
         self,
@@ -315,8 +325,12 @@ class RoundEngine:
         server_lr: float,
         key: jax.Array,
     ) -> Tuple[RoundState, RoundMetrics]:
-        """Execute one federated round. ``cx``/``cy``: ``[K, S, B, ...]``."""
-        return self._round_jit(
+        """Execute one federated round. ``cx``/``cy``: ``[K, S, B, ...]``.
+
+        The post-attack ``[K, D]`` update matrix of the round stays available
+        as ``self.last_updates`` (device-resident; only materialized on host
+        if the caller reads it)."""
+        new_state, metrics, updates = self._round_jit(
             state,
             cx,
             cy,
@@ -324,6 +338,8 @@ class RoundEngine:
             jnp.asarray(server_lr, jnp.float32),
             key,
         )
+        self.last_updates = updates
+        return new_state, metrics
 
     # -- evaluation ----------------------------------------------------------
 

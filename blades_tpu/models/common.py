@@ -61,7 +61,8 @@ def build_fns(
         logits = module.apply(
             {"params": params}, x, train=True, rngs={"dropout": key}
         )
-        return cross_entropy(logits, y)
+        top1 = jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
+        return cross_entropy(logits, y), {"top1": top1}
 
     def eval_logits_fn(params, x):
         return module.apply({"params": params}, x, train=False)

@@ -1,0 +1,48 @@
+"""Server handle.
+
+Reference: ``BladesServer`` (``src/blades/server.py:6-75``) owns the global
+model + optimizer and applies the aggregate as a pseudo-gradient
+(``p.grad = -x``). Here the server step is traced inside the round program
+(``core/engine.py``); this object is the host-side view exposing the same
+accessors.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class BladesServer:
+    def __init__(self, engine, state, aggregator):
+        self._engine = engine
+        self.state = state
+        self.aggregator = aggregator
+
+    def get_model(self) -> Any:
+        """Current global params pytree (reference returns the nn.Module)."""
+        return self.state.params
+
+    def get_opt(self) -> Any:
+        """Server optimizer state (reference returns the torch optimizer)."""
+        return self.state.server_opt_state
+
+    def zero_grad(self, set_to_none: bool = False) -> None:
+        """No-op: there are no persistent grads in a functional step; kept
+        for reference API parity (``server.py:39-52``)."""
+
+    def apply_update(self, update, server_lr: float = 0.1) -> None:
+        """Host-side escape hatch applying an aggregated ``[D]`` vector as a
+        pseudo-gradient step outside the jitted round (parity with
+        ``server.py:54-75``; the fused path in core/engine.py is preferred)."""
+        import jax
+
+        grad_tree = self._engine.unravel(-update)
+        server_updates, opt_state = self._engine._server_tx.update(
+            grad_tree, self.state.server_opt_state, self.state.params
+        )
+        params = jax.tree_util.tree_map(
+            lambda p, u: p - server_lr * u.astype(p.dtype),
+            self.state.params,
+            server_updates,
+        )
+        self.state = self.state._replace(params=params, server_opt_state=opt_state)

@@ -1,0 +1,363 @@
+"""Simulator: the public orchestrator, reference-API compatible.
+
+Reference: ``Simulator`` (``src/blades/simulator.py:21-457``). Construction
+surface (``__init__`` kwargs incl. strict unknown-kwarg error,
+``simulator.py:84-88``), ``run()`` signature (``simulator.py:364-377``),
+``get_clients`` / ``set_trusted_clients`` / ``register_attackers``
+(``simulator.py:138-187``) are all preserved. Ray-era knobs
+(``num_actors``, ``num_trainers``, ``gpu_per_actor``, ``mode``, ``use_cuda``)
+are accepted and ignored with a debug note — parallelism here comes from the
+device mesh, not actor counts.
+
+Execution: rounds run through :class:`blades_tpu.core.RoundEngine` — one
+jitted XLA program per round (SURVEY.md section 7), sharded over a
+``jax.sharding.Mesh`` when more than one device is visible.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blades_tpu.aggregators import get_aggregator
+from blades_tpu.attackers import ATTACKS, get_attack
+from blades_tpu.attackers.base import Attack, NoAttack
+from blades_tpu.client import BladesClient, ByzantineClient
+from blades_tpu.core import ClientOptSpec, RoundEngine, ServerOptSpec
+from blades_tpu.core.engine import multistep_lr
+from blades_tpu.datasets.base import BaseDataset
+from blades_tpu.datasets.fl import FLDataset
+from blades_tpu.models.common import ModelSpec, build_fns
+from blades_tpu.parallel.mesh import make_mesh, make_plan
+from blades_tpu.server import BladesServer
+from blades_tpu.utils.logging import initialize_logger
+from blades_tpu.utils.metrics import top1_accuracy
+
+_IGNORED_KWARGS = ("num_actors", "num_trainers", "gpu_per_actor", "mode", "use_cuda")
+
+
+class _CompositeAttack(Attack):
+    """Applies each registered custom attacker's omniscient hook to its own
+    rows of the update matrix (reference: per-client callbacks registered at
+    ``simulator.py:167-187`` and invoked at ``simulator.py:239-241``)."""
+
+    def __init__(self, entries):
+        # entries: list of (client_index, ByzantineClient)
+        self.entries = entries
+        attacks = [c.make_attack() for _, c in entries]
+        self.trains_dishonestly = any(
+            a is not None and a.trains_dishonestly for a in attacks
+        )
+
+    def init_state(self, num_clients, dim):
+        return tuple(
+            (c.make_attack().init_state(num_clients, dim) if c.make_attack() else ())
+            for _, c in self.entries
+        )
+
+    def on_batch(self, x, y, is_byz, *, num_classes, key):
+        # batch-level hooks require a uniform attack across byzantine clients
+        for _, c in self.entries:
+            a = c.make_attack()
+            if a is not None and a.trains_dishonestly:
+                return a.on_batch(x, y, is_byz, num_classes=num_classes, key=key)
+        return x, y
+
+    def on_grads(self, grads, is_byz):
+        for _, c in self.entries:
+            a = c.make_attack()
+            if a is not None and a.trains_dishonestly:
+                return a.on_grads(grads, is_byz)
+        return grads
+
+    def on_updates(self, updates, byz_mask, key, state=()):
+        k = updates.shape[0]
+        new_states = []
+        for (idx, client), st in zip(self.entries, state):
+            submask = jnp.zeros(k, bool).at[idx].set(True)
+            updates, st = client.omniscient_callback(updates, submask, key, st)
+            new_states.append(st)
+        return updates, tuple(new_states)
+
+
+class Simulator:
+    def __init__(
+        self,
+        dataset: Union[BaseDataset, FLDataset],
+        num_byzantine: Optional[int] = 0,
+        attack: Optional[str] = None,
+        attack_kws: Optional[Dict] = None,
+        aggregator: Union[str, Callable] = "mean",
+        aggregator_kws: Optional[Dict] = None,
+        log_path: str = "./outputs",
+        metrics: Optional[dict] = None,
+        seed: Optional[int] = None,
+        mesh_shape: Optional[tuple] = None,
+        num_actors: Optional[int] = 1,
+        num_trainers: Optional[int] = 1,
+        gpu_per_actor: Optional[float] = 0,
+        mode: Optional[str] = "actor",
+        use_cuda: Optional[bool] = False,
+        **kwargs,
+    ):
+        if kwargs:
+            # parity: strict unknown-kwarg error (simulator.py:84-88)
+            unknown = ", ".join(kwargs)
+            raise RuntimeError(f"Unknown keyword argument(s): {unknown}")
+
+        self.aggregator = get_aggregator(aggregator, **(aggregator_kws or {}))
+
+        if isinstance(dataset, FLDataset):
+            self.dataset = dataset
+            self._num_classes = int(jnp.max(dataset.test_y)) + 1
+            self._train_bs = 32
+        else:
+            self.dataset = dataset.get_dls()
+            self._num_classes = dataset.num_classes
+            self._train_bs = dataset.train_bs
+
+        self.seed = 0 if seed is None else int(seed)
+        self.num_byzantine = int(num_byzantine) if attack is not None else 0
+
+        # attack resolution, with auto-filled population hyperparams the
+        # reference makes callers pass by hand (e.g. ALIE's num_clients)
+        attack_kws = dict(attack_kws or {})
+        k = self.dataset.num_clients
+        if attack == "alie":
+            attack_kws.setdefault("num_clients", k)
+            attack_kws.setdefault("num_byzantine", self.num_byzantine)
+        if attack == "labelflipping":
+            attack_kws.setdefault("num_classes", self._num_classes)
+        self.attack = get_attack(attack, **attack_kws)
+
+        initialize_logger(log_path)
+        self.metrics = {"top1": top1_accuracy} if metrics is None else metrics
+        self.json_logger = logging.getLogger("stats")
+        self.debug_logger = logging.getLogger("debug")
+        self.debug_logger.info(self.__str__())
+
+        # client handles: first num_byzantine ids are byzantine
+        # (simulator.py:118-133)
+        self._clients: Dict = {}
+        for i, u in enumerate(self.dataset.get_clients()):
+            if i < self.num_byzantine:
+                self._clients[u] = ByzantineClient(id=u, attack=self.attack)
+            else:
+                self._clients[u] = BladesClient(id=u)
+
+        # device mesh: shard whenever >1 device is visible
+        devices = jax.devices()
+        if len(devices) > 1 or mesh_shape is not None:
+            self.plan = make_plan(make_mesh(devices, mesh_shape))
+        else:
+            self.plan = None
+
+        self._custom_attack_entries: List = []
+        self.server: Optional[BladesServer] = None
+        self.engine: Optional[RoundEngine] = None
+        for name in _IGNORED_KWARGS:
+            val = locals().get(name)
+            if val not in (None, 0, 1, "actor", False, 0.0):
+                self.debug_logger.info(
+                    f"note: {name}={val!r} is a Ray-era knob; parallelism "
+                    "comes from the device mesh here and the value is ignored."
+                )
+
+    def __str__(self) -> str:
+        return (
+            f"Simulator(num_clients={self.dataset.num_clients}, "
+            f"num_byzantine={self.num_byzantine}, attack={self.attack!r}, "
+            f"aggregator={self.aggregator!r})"
+        )
+
+    # -- reference API --------------------------------------------------------
+
+    def get_clients(self) -> List[BladesClient]:
+        return list(self._clients.values())
+
+    def set_trusted_clients(self, ids: List) -> None:
+        """Mark client ids trusted (FLTrust bootstrap; reference
+        ``simulator.py:143-151``)."""
+        for u in ids:
+            self._clients[u].trust()
+
+    def register_attackers(self, clients: List[ByzantineClient]) -> None:
+        """Replace the first ``len(clients)`` clients with custom attackers
+        (reference ``simulator.py:167-187``). Call before :meth:`run`."""
+        users = list(self._clients.keys())
+        if len(clients) > len(users):
+            raise ValueError("more attackers than clients")
+        self._custom_attack_entries = []
+        for i, c in enumerate(clients):
+            c._id = users[i]
+            self._clients[users[i]] = c
+            self._custom_attack_entries.append((i, c))
+        self.num_byzantine = max(self.num_byzantine, len(clients))
+
+    # -- run ------------------------------------------------------------------
+
+    @staticmethod
+    def _resolve_schedule(sched, lr0: float) -> Callable[[int], float]:
+        if sched is None:
+            return lambda r: lr0
+        if callable(sched):
+            return sched
+        if isinstance(sched, dict):
+            return multistep_lr(lr0, sched.get("milestones", ()), sched.get("gamma", 0.5))
+        raise TypeError(f"bad lr scheduler {sched!r}")
+
+    @staticmethod
+    def _resolve_opt(opt, cls):
+        if isinstance(opt, cls):
+            return opt
+        if isinstance(opt, str):
+            name = opt.lower()
+            if name in ("sgd", "adam"):
+                return cls(name=name)
+        raise ValueError(f"Unsupported optimizer {opt!r} (use 'SGD', 'Adam', or a spec)")
+
+    def run(
+        self,
+        model,
+        server_optimizer: Union[str, ServerOptSpec] = "SGD",
+        client_optimizer: Union[str, ClientOptSpec] = "SGD",
+        loss: Optional[str] = "crossentropy",
+        global_rounds: Optional[int] = 1,
+        local_steps: Optional[int] = 1,
+        validate_interval: Optional[int] = 1,
+        test_batch_size: Optional[int] = 64,
+        server_lr: Optional[float] = 0.1,
+        client_lr: Optional[float] = 0.1,
+        server_lr_scheduler=None,
+        client_lr_scheduler=None,
+        train_batch_size: Optional[int] = None,
+        retain_updates: bool = False,
+    ) -> List[float]:
+        """Run adversarial training; returns per-round wall times (reference
+        ``run`` contract, ``simulator.py:364-457``).
+
+        ``model``: a flax module, a :class:`ModelSpec`, or a registry name.
+        ``retain_updates``: copy each round's update rows onto the client
+        handles (host transfer; off by default — it is pure observability).
+        """
+        spec = self._model_spec(model, loss)
+        batch_size = train_batch_size or self._train_bs
+
+        key = jax.random.PRNGKey(self.seed)
+        params = spec.init(jax.random.fold_in(key, 17))
+
+        trusted = jnp.asarray(
+            [c.is_trusted() for c in self.get_clients()], dtype=bool
+        )
+        attack = self.attack
+        if self._custom_attack_entries:
+            attack = _CompositeAttack(self._custom_attack_entries)
+
+        self.engine = RoundEngine(
+            spec.train_loss_fn,
+            spec.eval_logits_fn,
+            params,
+            num_clients=self.dataset.num_clients,
+            num_byzantine=self.num_byzantine,
+            attack=attack,
+            aggregator=self.aggregator,
+            client_opt=self._resolve_opt(client_optimizer, ClientOptSpec),
+            server_opt=self._resolve_opt(server_optimizer, ServerOptSpec),
+            num_classes=self._num_classes,
+            trusted_mask=trusted,
+            plan=self.plan,
+        )
+        state = self.engine.init(params)
+        self.server = BladesServer(self.engine, state, self.aggregator)
+
+        client_lr_fn = self._resolve_schedule(client_lr_scheduler, client_lr)
+        server_lr_fn = self._resolve_schedule(server_lr_scheduler, server_lr)
+
+        data_key = jax.random.fold_in(key, 23)
+        round_times: List[float] = []
+        global_start = time.time()
+        for rnd in range(1, global_rounds + 1):
+            round_start = time.time()
+            cx, cy = self.dataset.sample_round(
+                jax.random.fold_in(data_key, rnd), local_steps, batch_size
+            )
+            c_lr = client_lr_fn(rnd - 1)
+            s_lr = server_lr_fn(rnd - 1)
+            state, m = self.engine.run_round(state, cx, cy, c_lr, s_lr, key)
+            self.server.state = state
+
+            self.log_train(rnd, local_steps, m)
+            self.log_variance(rnd, m)
+            if retain_updates:
+                # populate reference-parity client.get_update() views
+                for i, c in enumerate(self.get_clients()):
+                    c.save_update(self.engine.last_updates[i])
+
+            if rnd % validate_interval == 0:
+                ev = self.evaluate(rnd, test_batch_size)
+                self.debug_logger.info(
+                    f"Test global round {rnd}, loss: {ev['Loss']}, top1: {ev['top1']}"
+                )
+
+            round_times.append(time.time() - round_start)
+            self.debug_logger.info(
+                f"E={rnd}; Client learning rate = {c_lr}; "
+                f"Time cost = {time.time() - global_start}"
+            )
+        return round_times
+
+    def _model_spec(self, model, loss) -> ModelSpec:
+        if isinstance(model, ModelSpec):
+            return model
+        sample_shape = tuple(self.dataset.train_x.shape[2:])
+        if isinstance(model, str):
+            from blades_tpu.models import create_model
+
+            model = create_model(model, num_classes=self._num_classes)
+        return build_fns(model, sample_shape, loss=loss or "crossentropy")
+
+    # -- logging (stats-file schema parity, simulator.py:309-362) -------------
+
+    def log_train(self, rnd: int, local_steps: int, m) -> None:
+        r = {
+            "_meta": {"type": "train"},
+            "Round": rnd,
+            "B": local_steps,
+            "Loss": float(m.train_loss),
+            "top1": float(m.train_top1),
+        }
+        self.json_logger.info(r)
+        self.debug_logger.info(
+            f"[Round{rnd:3d}] Loss: {r['Loss']:.4f} top1={r['top1']:8.4f}"
+        )
+
+    def log_variance(self, rnd: int, m) -> None:
+        r = {
+            "_meta": {"type": "variance"},
+            "Round": rnd,
+            "avg": float(m.update_variance),
+            "norm": float(m.update_variance_norm),
+        }
+        self.json_logger.info(r)
+
+    def evaluate(self, rnd: int, batch_size: int = 64) -> Dict:
+        ev = self.engine.evaluate(
+            self.server.state,
+            self.dataset.test_x,
+            self.dataset.test_y,
+            batch_size=batch_size,
+        )
+        r = {
+            "_meta": {"type": "test"},
+            "Round": rnd,
+            "top1": float(ev["top1"]),
+            "Length": int(self.dataset.test_y.shape[0]),
+            "Loss": float(ev["Loss"]),
+        }
+        self.json_logger.info(r)
+        return ev
