@@ -1,0 +1,121 @@
+"""Flagship benchmark: 1000-client CIFAR-10-shaped fedsgd + trimmed-mean.
+
+This is the BASELINE.json north-star configuration (CCT-2 flagship model,
+K=1000 clients, local_steps=1, batch 32, trimmed-mean defense) executed as
+the framework runs it for real: every round is one jitted XLA program —
+device-side batch sampling, vmapped local SGD over all 1000 clients, the
+[K, D] update matrix, trimmed-mean reduction, server step.
+
+Baseline: BASELINE_PROXY.json, a measured torch-CPU serial proxy of the
+reference's round loop (see scripts/measure_baseline_proxy.py — the real
+reference needs Ray, absent here). Prints ONE json line:
+  {"metric": ..., "value": N, "unit": "rounds/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+K = int(os.environ.get("BENCH_CLIENTS", 1000))
+LOCAL_STEPS = int(os.environ.get("BENCH_LOCAL_STEPS", 1))
+BATCH = int(os.environ.get("BENCH_BATCH", 32))
+# sequential client chunks bound activation HBM (see RoundEngine docstring);
+# 10 chunks of 100 clients still push 3200 images per conv batch to the MXU
+CHUNKS = int(os.environ.get("BENCH_CHUNKS", 10))
+SAMPLES_PER_CLIENT = 50
+WARMUP, TIMED = 3, 10
+
+
+def main():
+    from blades_tpu.aggregators import get_aggregator
+    from blades_tpu.core import ClientOptSpec, RoundEngine, ServerOptSpec
+    from blades_tpu.datasets.fl import FLDataset
+    from blades_tpu.models import cct_2_3x2_32
+    from blades_tpu.models.common import build_fns
+    from blades_tpu.parallel.mesh import make_mesh, make_plan
+
+    rng = np.random.RandomState(0)
+    train_x = rng.randint(0, 256, (K, SAMPLES_PER_CLIENT, 32, 32, 3), dtype=np.uint8)
+    train_y = rng.randint(0, 10, (K, SAMPLES_PER_CLIENT)).astype(np.int32)
+    counts = np.full(K, SAMPLES_PER_CLIENT, np.int32)
+    from blades_tpu.datasets.augment import make_normalizer
+    from blades_tpu.datasets.cifar10 import CIFAR10_MEAN, CIFAR10_STD
+
+    ds = FLDataset(
+        train_x,
+        train_y,
+        counts,
+        train_x[0],
+        train_y[0],
+        normalize=make_normalizer(CIFAR10_MEAN, CIFAR10_STD),
+    )
+
+    spec = build_fns(cct_2_3x2_32(num_classes=10), sample_shape=(32, 32, 3))
+    params = spec.init(jax.random.PRNGKey(0))
+
+    devices = jax.devices()
+    plan = make_plan(make_mesh(devices)) if len(devices) > 1 else None
+    engine = RoundEngine(
+        spec.train_loss_fn,
+        spec.eval_logits_fn,
+        params,
+        num_clients=K,
+        num_byzantine=0,
+        aggregator=get_aggregator("trimmedmean"),
+        client_opt=ClientOptSpec(),
+        server_opt=ServerOptSpec(),
+        num_classes=10,
+        plan=plan,
+        client_chunks=CHUNKS,
+        remat=True,
+    )
+    state = engine.init(params)
+    key = jax.random.PRNGKey(7)
+
+    def one_round(state, r):
+        cx, cy = ds.sample_round(jax.random.fold_in(key, r), LOCAL_STEPS, BATCH)
+        state, m = engine.run_round(state, cx, cy, 0.1, 1.0, key)
+        return state, m
+
+    for r in range(WARMUP):
+        state, m = one_round(state, r)
+    jax.block_until_ready(state.params)
+
+    t0 = time.time()
+    for r in range(WARMUP, WARMUP + TIMED):
+        state, m = one_round(state, r)
+    jax.block_until_ready(state.params)
+    elapsed = time.time() - t0
+
+    rounds_per_sec = TIMED / elapsed
+    assert np.isfinite(float(m.train_loss)), "non-finite loss"
+
+    baseline_path = os.path.join(os.path.dirname(__file__), "BASELINE_PROXY.json")
+    vs = None
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            vs = rounds_per_sec / json.load(f)["rounds_per_sec"]
+
+    print(
+        json.dumps(
+            {
+                "metric": "cifar10_fedsgd_trimmedmean_1000c_rounds_per_sec",
+                "value": round(rounds_per_sec, 4),
+                "unit": "rounds/sec",
+                "vs_baseline": round(vs, 2) if vs is not None else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -141,7 +141,17 @@ class RoundEngine:
         loss_clamp: float = 1e6,
         trusted_mask: Optional[jnp.ndarray] = None,
         plan: Optional[ShardingPlan] = None,
+        client_chunks: int = 1,
+        remat: bool = False,
     ):
+        """``client_chunks``: split the K client axis into this many
+        sequential chunks (``lax.map`` outside, vmap inside). Each chunk still
+        batches ``K/chunks x B`` samples through every layer — plenty to fill
+        the MXU — while activation memory scales with the chunk, not with K.
+        This is the HBM lever for large populations (K=1000 x CCT backward
+        would otherwise materialize 32k-image activations). ``remat``
+        additionally rematerializes each local step's forward during the
+        backward pass."""
         self.train_loss_fn = train_loss_fn
         self.eval_logits_fn = eval_logits_fn
         self.num_clients = int(num_clients)
@@ -153,6 +163,13 @@ class RoundEngine:
         self.num_classes = int(num_classes)
         self.loss_clamp = float(loss_clamp)
         self.plan = plan
+        self.client_chunks = int(client_chunks)
+        if self.num_clients % self.client_chunks:
+            raise ValueError(
+                f"num_clients {num_clients} not divisible by "
+                f"client_chunks {client_chunks}"
+            )
+        self.remat = bool(remat)
 
         self.dim, self.unravel = make_unraveler(params_template)
         # Reference convention: the FIRST num_byzantine client ids are
@@ -229,6 +246,8 @@ class RoundEngine:
                 # attack-induced blowups (client.py:191)
                 return jnp.clip(loss, 0.0, self.loss_clamp), aux
 
+            if self.remat:
+                clamped_loss = jax.checkpoint(clamped_loss)
             (loss, aux), grads = jax.value_and_grad(clamped_loss, has_aux=True)(p)
             grads = self.attack.on_grads(grads, is_byz)
             updates, ost = self._client_tx.update(grads, ost, p)
@@ -258,10 +277,45 @@ class RoundEngine:
         else:
             in_axes = (None, None, None, 0, 0, 0, 0)
             opt_arg = ()
+        vmapped = jax.vmap(self._local_update, in_axes=in_axes)
 
-        updates, new_client_opt, losses, top1s = jax.vmap(
-            self._local_update, in_axes=in_axes
-        )(state.params, opt_arg, client_lr, cx, cy, client_keys, self.byz_mask)
+        if self.client_chunks == 1:
+            updates, new_client_opt, losses, top1s = vmapped(
+                state.params, opt_arg, client_lr, cx, cy, client_keys, self.byz_mask
+            )
+        else:
+            # HBM lever: sequential lax.map over client chunks, vmap inside.
+            # Chunks occupy a fresh leading axis (unsharded); the inner client
+            # axis keeps the mesh sharding, so every device still works on
+            # every chunk.
+            c = self.client_chunks
+
+            def chunked(t):
+                return jax.tree_util.tree_map(
+                    lambda a: a.reshape((c, a.shape[0] // c) + a.shape[1:]), t
+                )
+
+            opt_c = chunked(opt_arg) if self.client_opt.persist else opt_arg
+
+            def run_chunk(args):
+                o, x, y, k, b = args
+                return vmapped(state.params, o if self.client_opt.persist else (),
+                               client_lr, x, y, k, b)
+
+            updates, new_client_opt, losses, top1s = lax.map(
+                run_chunk,
+                (opt_c, chunked(cx), chunked(cy), chunked(client_keys),
+                 chunked(self.byz_mask)),
+            )
+
+            def unchunk(t):
+                return jax.tree_util.tree_map(
+                    lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), t
+                )
+
+            updates, losses, top1s = unchunk((updates, losses, top1s))
+            if self.client_opt.persist:
+                new_client_opt = unchunk(new_client_opt)
         if not self.client_opt.persist:
             new_client_opt = ()
 

@@ -1,0 +1,22 @@
+"""LEAF-style offline data utilities.
+
+Reference: ``src/blades/models/utils/`` (~717 LoC of standalone argparse
+tools over LEAF-format federated JSON data: non-IID sampling, train/test
+split, stats, user pruning — SURVEY.md C11). Same JSON schema
+(``{"users": [...], "num_samples": [...], "user_data": {u: {"x": [...],
+"y": [...]}}}``), same CLI entry points, re-implemented compactly:
+
+    python -m blades_tpu.leaf.sample --data-dir D --out-dir O --fraction 0.1
+    python -m blades_tpu.leaf.split_data --data-dir D --out-dir O --frac 0.9
+    python -m blades_tpu.leaf.stats --data-dir D
+    python -m blades_tpu.leaf.remove_users --data-dir D --out-dir O --min-samples 10
+
+(The reference's GDrive ``download_util.py`` is intentionally absent: this
+build performs no network downloads.)
+"""
+
+from blades_tpu.leaf.util import iid_divide, read_leaf_dir, write_leaf_json
+
+DATASETS = ["sent140", "femnist", "shakespeare", "celeba", "synthetic", "reddit"]
+
+__all__ = ["DATASETS", "iid_divide", "read_leaf_dir", "write_leaf_json"]

@@ -1,0 +1,51 @@
+"""Train/test split of LEAF data (reference: ``models/utils/split_data.py``):
+per-user fraction split, preserving the LEAF JSON schema."""
+
+from __future__ import annotations
+
+import argparse
+import random
+
+from blades_tpu.leaf.util import read_leaf_dir, write_leaf_json
+
+
+def split_leaf(data, frac: float = 0.9, seed: int = 0):
+    rng = random.Random(seed)
+    train = {"users": [], "num_samples": [], "user_data": {}}
+    test = {"users": [], "num_samples": [], "user_data": {}}
+    for u in data["users"]:
+        xs, ys = data["user_data"][u]["x"], data["user_data"][u]["y"]
+        idx = list(range(len(ys)))
+        rng.shuffle(idx)
+        cut = max(1, int(frac * len(idx))) if len(idx) > 1 else len(idx)
+        tr, te = idx[:cut], idx[cut:]
+        for side, ids in ((train, tr), (test, te)):
+            if not ids:
+                continue
+            side["users"].append(u)
+            side["num_samples"].append(len(ids))
+            side["user_data"][u] = {
+                "x": [xs[i] for i in ids],
+                "y": [ys[i] for i in ids],
+            }
+    return train, test
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--out-dir", required=True)
+    p.add_argument("--frac", type=float, default=0.9)
+    p.add_argument("--seed", type=int, default=0)
+    a = p.parse_args(argv)
+    train, test = split_leaf(read_leaf_dir(a.data_dir), a.frac, a.seed)
+    write_leaf_json(train, f"{a.out_dir}/train/train.json")
+    write_leaf_json(test, f"{a.out_dir}/test/test.json")
+    print(
+        f"train: {sum(train['num_samples'])} samples; "
+        f"test: {sum(test['num_samples'])} samples"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -212,8 +212,10 @@ class CCT(nn.Module):
             x = x + sinusoidal_embedding(seq_len, self.embedding_dim)
 
         x = nn.Dropout(self.dropout)(x, deterministic=det)
+        # static (host) linspace: drop-path rates are compile-time constants
         dpr = [
-            float(r) for r in jnp.linspace(0.0, self.stochastic_depth, self.num_layers)
+            self.stochastic_depth * i / max(self.num_layers - 1, 1)
+            for i in range(self.num_layers)
         ]
         for i in range(self.num_layers):
             x = TransformerEncoderLayer(

@@ -17,6 +17,7 @@ jitted XLA program per round (SURVEY.md section 7), sharded over a
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Callable, Dict, List, Optional, Union
 
@@ -237,6 +238,12 @@ class Simulator:
         client_lr_scheduler=None,
         train_batch_size: Optional[int] = None,
         retain_updates: bool = False,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_interval: int = 0,
+        resume: bool = False,
+        profile_dir: Optional[str] = None,
+        client_chunks: int = 1,
+        remat: bool = False,
     ) -> List[float]:
         """Run adversarial training; returns per-round wall times (reference
         ``run`` contract, ``simulator.py:364-457``).
@@ -244,6 +251,11 @@ class Simulator:
         ``model``: a flax module, a :class:`ModelSpec`, or a registry name.
         ``retain_updates``: copy each round's update rows onto the client
         handles (host transfer; off by default — it is pure observability).
+        ``checkpoint_path``/``checkpoint_interval``/``resume``: save the full
+        round state every N rounds and resume bit-exactly (absent in the
+        reference, SURVEY.md section 5). ``profile_dir``: capture a
+        ``jax.profiler`` trace of rounds 2-4. ``client_chunks``/``remat``:
+        HBM control for large populations (see RoundEngine).
         """
         spec = self._model_spec(model, loss)
         batch_size = train_batch_size or self._train_bs
@@ -271,8 +283,18 @@ class Simulator:
             num_classes=self._num_classes,
             trusted_mask=trusted,
             plan=self.plan,
+            client_chunks=client_chunks,
+            remat=remat,
         )
         state = self.engine.init(params)
+
+        start_round = 1
+        if resume and checkpoint_path and os.path.exists(checkpoint_path):
+            from blades_tpu.utils.checkpoint import restore_state
+
+            state = restore_state(checkpoint_path, state)
+            start_round = int(state.round_idx) + 1
+            self.debug_logger.info(f"resumed from {checkpoint_path} at round {start_round}")
         self.server = BladesServer(self.engine, state, self.aggregator)
 
         client_lr_fn = self._resolve_schedule(client_lr_scheduler, client_lr)
@@ -281,7 +303,9 @@ class Simulator:
         data_key = jax.random.fold_in(key, 23)
         round_times: List[float] = []
         global_start = time.time()
-        for rnd in range(1, global_rounds + 1):
+        for rnd in range(start_round, global_rounds + 1):
+            if profile_dir and rnd == 2:
+                jax.profiler.start_trace(profile_dir)
             round_start = time.time()
             cx, cy = self.dataset.sample_round(
                 jax.random.fold_in(data_key, rnd), local_steps, batch_size
@@ -303,6 +327,18 @@ class Simulator:
                 self.debug_logger.info(
                     f"Test global round {rnd}, loss: {ev['Loss']}, top1: {ev['top1']}"
                 )
+
+            if profile_dir and rnd == min(4, global_rounds):
+                jax.block_until_ready(state.params)
+                jax.profiler.stop_trace()
+            if (
+                checkpoint_path
+                and checkpoint_interval
+                and rnd % checkpoint_interval == 0
+            ):
+                from blades_tpu.utils.checkpoint import save_state
+
+                save_state(checkpoint_path, state)
 
             round_times.append(time.time() - round_start)
             self.debug_logger.info(

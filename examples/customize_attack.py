@@ -1,0 +1,78 @@
+"""
+Customization of attack strategy
+================================
+
+Reference flow: subclass ``ByzantineClient`` and override lifecycle methods
+(``src/blades/examples/customize_attack.py``). Here the hooks are *pure
+functions* that run inside the compiled round program — subclass
+:class:`blades_tpu.attackers.Attack` for the transform and attach it to a
+:class:`blades_tpu.client.ByzantineClient`:
+
+- ``on_grads``    — corrupt per-step gradients (replaces overriding
+  ``local_training`` for sign-flip-style attacks).
+- ``on_batch``    — modify each training batch (``on_train_batch_begin``).
+- ``on_updates``  — full omniscient knowledge: rewrite rows of the global
+  ``[K, D]`` update matrix (``omniscient_callback``).
+"""
+
+import sys
+
+import jax.numpy as jnp
+
+from blades_tpu.attackers.base import Attack, honest_stats
+from blades_tpu.client import ByzantineClient
+from blades_tpu.datasets import MNIST, Synthetic
+from blades_tpu.simulator import Simulator
+
+
+class MaliciousAttack(Attack):
+    """Sign-flips gradients, flips labels, and uploads -100x the honest
+    mean — the same triple attack as the reference example."""
+
+    trains_dishonestly = True
+
+    def __init__(self, num_classes=10):
+        self.num_classes = num_classes
+
+    def on_batch(self, x, y, is_byz, *, num_classes, key):
+        return x, jnp.where(is_byz, self.num_classes - 1 - y, y)
+
+    def on_grads(self, grads, is_byz):
+        import jax
+
+        sign = jnp.where(is_byz, -1.0, 1.0)
+        return jax.tree_util.tree_map(lambda g: g * sign.astype(g.dtype), grads)
+
+    def on_updates(self, updates, byz_mask, key, state=()):
+        mu, _, _ = honest_stats(updates, byz_mask)
+        return jnp.where(byz_mask[:, None], -100.0 * mu[None, :], updates), state
+
+
+class MaliciousClient(ByzantineClient):
+    def make_attack(self):
+        return MaliciousAttack()
+
+
+if "--synthetic" in sys.argv:
+    dataset = Synthetic(num_clients=10, train_bs=32, train_size=4000)
+else:
+    dataset = MNIST(data_root="./data", train_bs=32, num_clients=10)
+
+simulator = Simulator(
+    dataset=dataset,
+    aggregator="clippedclustering",  # defense: robust aggregation
+    seed=1,
+)
+# replace the first 5 clients with the custom attacker
+simulator.register_attackers([MaliciousClient() for _ in range(5)])
+
+simulator.run(
+    model="mlp",
+    server_optimizer="SGD",
+    client_optimizer="SGD",
+    loss="crossentropy",
+    global_rounds=50,
+    local_steps=50,
+    server_lr=1.0,
+    client_lr=0.1,
+)

@@ -1,0 +1,47 @@
+"""
+Comparing built-in aggregation schemes
+======================================
+
+Reference: ``src/blades/examples/plot_comparing_aggregation_schemes.py`` —
+60 benign 2-D Gaussian samples + 40 outliers pushed through every aggregator;
+robust ones must land inside the benign cluster. This doubles as the
+statistical sanity check the test suite formalizes (tests/test_aggregators.py).
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from blades_tpu.aggregators import AGGREGATORS, get_aggregator
+
+np.random.seed(1)
+benign = np.random.normal(0.0, 1.0, (60, 2))
+outlier = np.random.normal(7.0, 1.0, (40, 2))
+data = jnp.asarray(np.concatenate([benign, outlier]).astype(np.float32))
+
+results = {}
+for name in sorted(AGGREGATORS):
+    if name == "fltrust":  # needs a designated trusted row
+        continue
+    agg = get_aggregator(name)
+    results[name] = np.asarray(agg(data))
+    dist = np.linalg.norm(results[name] - benign.mean(0))
+    tag = "ROBUST" if dist < 1.0 else "pulled"
+    print(f"{name:18s} -> {np.round(results[name], 3)}  (dist to benign mean: {dist:5.2f}) {tag}")
+
+try:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    plt.scatter(benign[:, 0], benign[:, 1], s=8, alpha=0.4, label="benign")
+    plt.scatter(outlier[:, 0], outlier[:, 1], s=8, alpha=0.4, label="outlier")
+    for name, p in results.items():
+        plt.scatter(*p, marker="x", s=60)
+        plt.annotate(name, p, fontsize=7)
+    plt.legend()
+    plt.savefig("aggregation_schemes.png", dpi=120)
+    print("wrote aggregation_schemes.png")
+except Exception as e:  # matplotlib optional
+    print(f"(plot skipped: {e})")

@@ -1,0 +1,84 @@
+"""Experiment flag system (reference: ``scripts/args.py:7-68``).
+
+Same flags, same derived per-attack / per-aggregator kwarg dicts, same
+config-encoding log-dir naming. GPU-era knobs are kept for CLI compatibility
+but parallelism comes from the visible TPU/CPU device mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def parse_arguments(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("--global_round", type=int, default=400)
+    parser.add_argument("--local_round", type=int, default=50)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--test_batch_size", type=int, default=128)
+    parser.add_argument("--log_interval", type=int, default=10)
+    parser.add_argument("--attack", type=str, default="signflipping",
+                        help="Select attack types.")
+    parser.add_argument("--dataset", type=str, default="cifar10")
+    parser.add_argument("--model", type=str, default="cct")
+    parser.add_argument("--agg", type=str, default="clippedclustering",
+                        help="Aggregator.")
+    parser.add_argument("--lr", type=float, default=0.1, help="learning rate")
+    parser.add_argument("--num_clients", type=int, default=20)
+    parser.add_argument("--num_byzantine", type=int, default=8)
+    parser.add_argument("--noniid", action="store_true", default=False)
+    parser.add_argument("--alpha", type=float, default=0.1,
+                        help="Dirichlet concentration for non-IID partition")
+    parser.add_argument("--synthetic", action="store_true", default=False,
+                        help="use the offline synthetic dataset")
+    # accepted-for-compatibility (ignored; mesh decides parallelism)
+    parser.add_argument("--use-cuda", action="store_true", default=False)
+    parser.add_argument("--num_actors", type=int, default=20)
+    parser.add_argument("--num_gpus", type=int, default=0)
+    options = parser.parse_args(argv)
+
+    root_dir = os.path.dirname(os.path.abspath(__file__))
+    exp_dir = os.path.join(root_dir, f"outputs/{options.dataset}")
+
+    options.attack_args = {
+        "noise": {},
+        "labelflipping": {},
+        "signflipping": {},
+        "alie": {},
+        "ipm": {"epsilon": 0.5},
+        "minmax": {},
+        "minsum": {},
+    }
+    options.agg_args = {
+        "mean": {},
+        "median": {},
+        "trimmedmean": {"num_byzantine": options.num_byzantine},
+        "krum": {"num_byzantine": options.num_byzantine},
+        "multikrum": {"num_byzantine": options.num_byzantine},
+        "geomed": {},
+        "autogm": {},
+        "centeredclipping": {},
+        "clustering": {},
+        "clippedclustering": {},
+        "dnc": {"num_byzantine": options.num_byzantine},
+        "signguard": {},
+        "fltrust": {},
+        "byzantinesgd": {},
+    }
+
+    attack_kw = options.attack_args.get(options.attack, {})
+    agg_kw = options.agg_args.get(options.agg, {})
+    options.log_dir = (
+        exp_dir
+        + f"/b{options.num_byzantine}"
+        + f"_{options.attack}"
+        + ("_" + "_".join(k + str(v) for k, v in attack_kw.items()) if attack_kw else "")
+        + f"_{options.agg}"
+        + ("_" + "_".join(k + str(v) for k, v in agg_kw.items()) if agg_kw else "")
+        + f"_lr{options.lr}"
+        + f"_bz{options.batch_size}"
+        + f"_seed{options.seed}"
+    )
+    return options

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Experiment sweep launcher (reference: scripts/cifar10.sh — nohup grid over
+# seeds x attacks x aggregators). Serial here: one TPU, one process.
+set -e
+cd "$(dirname "$0")"
+
+SEEDS="${SEEDS:-1 2 3}"
+ATTACKS="${ATTACKS:-signflipping ipm alie labelflipping noise}"
+AGGS="${AGGS:-mean median trimmedmean krum geomed clippedclustering}"
+EXTRA="${EXTRA:---synthetic --global_round 50}"
+
+for seed in $SEEDS; do
+  for attack in $ATTACKS; do
+    for agg in $AGGS; do
+      echo "== seed=$seed attack=$attack agg=$agg"
+      python cifar10.py --seed "$seed" --attack "$attack" --agg "$agg" $EXTRA
+    done
+  done
+done

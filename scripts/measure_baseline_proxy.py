@@ -1,0 +1,132 @@
+"""Measure the reference-equivalent torch-CPU rounds/sec proxy.
+
+The actual reference (bladesteam/blades) cannot run in this image: it needs
+Ray (not installed) and its GPU path needs CUDA (absent). This proxy
+re-creates the reference's measured quantity — one synchronous FL round =
+K clients x ``local_steps`` of SGD on a CCT-2-sized torch model, plus update
+flatten + trimmed-mean aggregation on the driver — exactly the work
+``_RayActor.local_training`` does serially per actor
+(``/root/reference/src/blades/actor.py:23-33``). We time a few clients and
+extrapolate linearly to K=1000 (serial client multiplexing IS linear in K;
+ignoring Ray's per-round model/update serialization makes the proxy strictly
+GENEROUS to the reference).
+
+Writes BASELINE_PROXY.json at the repo root; bench.py reads it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+K_TARGET = 1000
+K_MEASURE = 8
+LOCAL_STEPS = 1
+BATCH = 32
+
+
+class TinyCCT(nn.Module):
+    """Torch model with CCT-2's compute shape (2 conv tokenizer layers,
+    2 transformer encoder layers, dim 128, seq-pool). ~284K params."""
+
+    def __init__(self, num_classes: int = 10, dim: int = 128):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 3, 1, 1, bias=False)
+        self.conv2 = nn.Conv2d(64, dim, 3, 1, 1, bias=False)
+        self.pool = nn.MaxPool2d(3, 2, 1)
+        enc = nn.TransformerEncoderLayer(
+            dim, 2, dim, dropout=0.1, activation="gelu", batch_first=True,
+            norm_first=True,
+        )
+        self.blocks = nn.TransformerEncoder(enc, 2)
+        self.attn_pool = nn.Linear(dim, 1)
+        self.fc = nn.Linear(dim, num_classes)
+        self.pos = nn.Parameter(torch.zeros(1, 64, dim))
+
+    def forward(self, x):
+        x = self.pool(F.relu(self.conv1(x)))
+        x = self.pool(F.relu(self.conv2(x)))
+        x = x.flatten(2).transpose(1, 2) + self.pos
+        x = self.blocks(x)
+        w = torch.softmax(self.attn_pool(x), dim=1)
+        x = (w.transpose(1, 2) @ x).squeeze(1)
+        return self.fc(x)
+
+
+def main():
+    torch.manual_seed(0)
+    model = TinyCCT()
+    n_params = sum(p.numel() for p in model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    data = torch.randn(BATCH, 3, 32, 32)
+    target = torch.randint(0, 10, (BATCH,))
+
+    def one_client():
+        # reference client round: snapshot params, local SGD, flatten delta
+        # (client.py:114-131, 178-228)
+        saved = [p.detach().clone() for p in model.parameters()]
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        for _ in range(LOCAL_STEPS):
+            opt.zero_grad()
+            loss = torch.clamp(loss_fn(model(data), target), 0, 1e6)
+            loss.backward()
+            opt.step()
+        update = torch.cat(
+            [
+                (p.detach() - s).view(-1)
+                for p, s in zip(model.parameters(), saved)
+            ]
+        )
+        for p, s in zip(model.parameters(), saved):  # restore global model
+            p.data.copy_(s)
+        return update
+
+    one_client()  # warmup
+    t0 = time.time()
+    updates = [one_client() for _ in range(K_MEASURE)]
+    per_client = (time.time() - t0) / K_MEASURE
+
+    # driver-side trimmed-mean over the stacked matrix (trimmedmean.py:27-45)
+    stacked = torch.stack([u for u in updates for _ in range(2)])
+    t0 = time.time()
+    b = 2
+    largest, _ = torch.topk(stacked, b, dim=0)
+    neg_smallest, _ = torch.topk(-stacked, b, dim=0)
+    new_stacked = torch.cat([stacked, -largest, neg_smallest]).sum(0)
+    new_stacked /= len(stacked) - 2 * b
+    agg_time_small = time.time() - t0
+    # aggregation is O(K*D); extrapolate to K=1000 rows
+    agg_time = agg_time_small * (K_TARGET / stacked.shape[0])
+
+    round_time = per_client * K_TARGET + agg_time
+    result = {
+        "metric": "cifar10_fedsgd_trimmedmean_1000c_rounds_per_sec",
+        "rounds_per_sec": 1.0 / round_time,
+        "per_client_sec": per_client,
+        "agg_sec_extrapolated": agg_time,
+        "model_params": n_params,
+        "k_target": K_TARGET,
+        "k_measured": K_MEASURE,
+        "local_steps": LOCAL_STEPS,
+        "batch": BATCH,
+        "hardware": f"torch-cpu x{os.cpu_count()} (reference proxy; Ray absent)",
+        "note": (
+            "Serial torch-CPU proxy of the reference round "
+            "(actor.py:23-33); linear extrapolation over clients, "
+            "generous to the reference (Ray IPC costs excluded)."
+        ),
+    }
+    out = os.path.join(os.path.dirname(__file__), "..", "BASELINE_PROXY.json")
+    with open(os.path.abspath(out), "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

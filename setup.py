@@ -1,0 +1,32 @@
+"""Packaging (reference: ``src/setup.py`` — pip package ``blades`` v0.0.14).
+
+Dependencies are the TPU-native substrate: jax/flax/optax replace the
+reference's torch+ray+sklearn stack (``src/setup.py:5-16``).
+"""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="blades-tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native (JAX/XLA) simulator for Byzantine attacks and robust "
+        "aggregation defenses in federated learning"
+    ),
+    long_description=open("README.md").read(),
+    long_description_content_type="text/markdown",
+    packages=find_packages(exclude=("tests", "examples", "scripts")),
+    python_requires=">=3.10",
+    install_requires=[
+        "jax>=0.4.30",
+        "flax>=0.8",
+        "optax>=0.2",
+        "numpy",
+        "scipy",
+    ],
+    extras_require={
+        "test": ["pytest", "chex"],
+        "checkpoint": ["orbax-checkpoint"],
+    },
+    license="Apache-2.0",
+)

@@ -1,0 +1,122 @@
+"""Attack-suite unit tests against the reference's closed-form semantics
+(src/blades/attackers/*.py; see SURVEY.md section 4 — the reference has no
+tests, so expectations come from the attack definitions themselves)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import norm
+
+from blades_tpu.attackers import ATTACKS, get_attack
+from blades_tpu.attackers.base import NoAttack, honest_stats
+
+K, D, F = 10, 6, 3
+KEY = jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def updates():
+    return jax.random.normal(jax.random.PRNGKey(1), (K, D))
+
+
+@pytest.fixture
+def byz_mask():
+    return jnp.arange(K) < F
+
+
+def test_registry_names():
+    # reference ships these five (simulator.py:30-32)
+    for name in ["noise", "labelflipping", "signflipping", "alie", "ipm"]:
+        assert name in ATTACKS
+
+
+def test_noattack_identity(updates, byz_mask):
+    out, _ = NoAttack().on_updates(updates, byz_mask, KEY)
+    np.testing.assert_array_equal(out, updates)
+
+
+def test_noise_replaces_only_byzantine_rows(updates, byz_mask):
+    out, _ = get_attack("noise", mean=0.1, std=0.1).on_updates(updates, byz_mask, KEY)
+    np.testing.assert_array_equal(out[F:], updates[F:])
+    assert not np.allclose(out[:F], updates[:F])
+    # large-sample moments: N(0.1, 0.1) (noiseclient.py:22-25)
+    big, _ = get_attack("noise").on_updates(
+        jnp.zeros((4, 20000)), jnp.ones(4, bool), KEY
+    )
+    assert abs(float(big.mean()) - 0.1) < 0.01
+    assert abs(float(big.std()) - 0.1) < 0.01
+
+
+def test_ipm_closed_form(updates, byz_mask):
+    eps = 0.5
+    out, _ = get_attack("ipm", epsilon=eps).on_updates(updates, byz_mask, KEY)
+    honest_mean = updates[F:].mean(axis=0)
+    np.testing.assert_allclose(out[:F], jnp.tile(-eps * honest_mean, (F, 1)), rtol=1e-5)
+    np.testing.assert_array_equal(out[F:], updates[F:])
+
+
+def test_alie_closed_form(updates, byz_mask):
+    atk = get_attack("alie", num_clients=K, num_byzantine=F)
+    out, _ = atk.on_updates(updates, byz_mask, KEY)
+    honest = np.asarray(updates[F:])
+    mu = honest.mean(axis=0)
+    std = honest.std(axis=0, ddof=1)  # torch.std is unbiased
+    s = np.floor(K / 2 + 1) - F
+    z = norm.ppf((K - F - s) / (K - F))
+    np.testing.assert_allclose(out[:F], np.tile(mu - z * std, (F, 1)), rtol=1e-4)
+    np.testing.assert_array_equal(out[F:], updates[F:])
+
+
+def test_alie_explicit_z():
+    atk = get_attack("alie", num_clients=K, num_byzantine=F, z=1.5)
+    assert atk._z_max(K, F) == 1.5
+
+
+def test_labelflipping_batch_hook():
+    atk = get_attack("labelflipping", num_classes=10)
+    y = jnp.array([0, 3, 9])
+    _, y_byz = atk.on_batch(None, y, jnp.asarray(True), num_classes=10, key=KEY)
+    np.testing.assert_array_equal(y_byz, [9, 6, 0])
+    _, y_hon = atk.on_batch(None, y, jnp.asarray(False), num_classes=10, key=KEY)
+    np.testing.assert_array_equal(y_hon, y)
+
+
+def test_signflipping_grad_hook():
+    atk = get_attack("signflipping")
+    grads = {"w": jnp.ones((2, 2)), "b": -jnp.ones(2)}
+    flipped = atk.on_grads(grads, jnp.asarray(True))
+    np.testing.assert_array_equal(flipped["w"], -jnp.ones((2, 2)))
+    kept = atk.on_grads(grads, jnp.asarray(False))
+    np.testing.assert_array_equal(kept["w"], jnp.ones((2, 2)))
+
+
+def test_minmax_within_envelope(updates, byz_mask):
+    out, _ = get_attack("minmax").on_updates(updates, byz_mask, KEY)
+    honest = np.asarray(updates[F:])
+    mal = np.asarray(out[0])
+    max_pair = max(
+        np.sum((a - b) ** 2) for a in honest for b in honest
+    )
+    d = max(np.sum((mal - h) ** 2) for h in honest)
+    assert d <= max_pair * 1.05  # bisection tolerance
+
+
+def test_honest_stats_masking(updates, byz_mask):
+    mu, std, n = honest_stats(updates, byz_mask)
+    np.testing.assert_allclose(mu, np.asarray(updates[F:]).mean(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(
+        std, np.asarray(updates[F:]).std(axis=0, ddof=1), rtol=1e-5
+    )
+    assert float(n) == K - F
+
+
+def test_attacks_jittable(updates, byz_mask):
+    for name in ATTACKS:
+        kw = {"num_clients": K, "num_byzantine": F} if name == "alie" else {}
+        atk = get_attack(name, **kw)
+        out, _ = jax.jit(lambda u, m, k: atk.on_updates(u, m, k, ()))(
+            updates, byz_mask, KEY
+        )
+        assert out.shape == updates.shape
+        assert bool(jnp.all(jnp.isfinite(out)))

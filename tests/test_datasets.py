@@ -1,0 +1,121 @@
+"""Dataset layer tests: partition semantics, round sampling, augmentation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.datasets import (
+    CustomTensorDataset,
+    FLDataset,
+    Synthetic,
+    partition_dirichlet,
+    partition_iid,
+)
+from blades_tpu.datasets.augment import (
+    cifar_train_transform,
+    make_normalizer,
+    random_crop,
+    random_hflip,
+)
+
+
+def test_partition_iid_balanced():
+    x = np.arange(100).reshape(100, 1)
+    y = np.arange(100) % 10
+    xs, ys = partition_iid(x, y, num_clients=10, seed=0)
+    assert len(xs) == 10
+    assert all(len(a) == 10 for a in xs)
+    # all samples present exactly once
+    assert sorted(np.concatenate(xs).ravel().tolist()) == list(range(100))
+
+
+def test_partition_dirichlet_skew_and_coverage():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1000, 3)
+    y = rng.randint(0, 10, 1000)
+    xs, ys = partition_dirichlet(x, y, num_clients=20, alpha=0.1, seed=0)
+    sizes = np.array([len(a) for a in xs])
+    assert sizes.sum() == 1000
+    assert sizes.min() >= 1
+    # alpha=0.1 must be visibly non-IID: client class histograms skewed
+    hists = np.stack(
+        [np.bincount(b, minlength=10) / max(len(b), 1) for b in ys]
+    )
+    assert hists.max(axis=1).mean() > 0.35  # IID would be ~0.1
+
+
+def test_fldataset_sampling_without_replacement():
+    k, n = 4, 12
+    train_x = np.tile(np.arange(n, dtype=np.float32)[None, :, None], (k, 1, 1))
+    train_y = np.tile(np.arange(n, dtype=np.int32)[None], (k, 1))
+    ds = FLDataset(train_x, train_y, np.full(k, n), train_x[0], train_y[0])
+    # one epoch's worth: every sample exactly once per client
+    cx, cy = ds.sample_round(jax.random.PRNGKey(0), local_steps=3, batch_size=4)
+    assert cx.shape == (k, 3, 4, 1)
+    for c in range(k):
+        seen = sorted(np.asarray(cy[c]).ravel().tolist())
+        assert seen == list(range(n))
+
+
+def test_fldataset_wraparound_past_epoch():
+    k, n = 2, 3
+    train_x = np.zeros((k, n, 1), np.float32)
+    train_y = np.tile(np.arange(n, dtype=np.int32)[None], (k, 1))
+    ds = FLDataset(train_x, train_y, np.full(k, n), train_x[0], train_y[0])
+    _, cy = ds.sample_round(jax.random.PRNGKey(0), local_steps=2, batch_size=3)
+    for c in range(k):
+        flat = np.asarray(cy[c]).ravel()
+        # 6 draws over 3 samples -> each appears exactly twice (wraparound)
+        assert sorted(np.bincount(flat, minlength=n).tolist()) == [2, 2, 2]
+
+
+def test_fldataset_padding_never_sampled():
+    k = 2
+    train_x = np.zeros((k, 10, 1), np.float32)
+    train_y = np.full((k, 10), -1, np.int32)
+    train_y[:, :4] = np.arange(4)
+    ds = FLDataset(train_x, train_y, np.array([4, 4]), train_x[0], train_y[0])
+    _, cy = ds.sample_round(jax.random.PRNGKey(3), local_steps=5, batch_size=2)
+    assert int(cy.min()) >= 0  # -1 padding rows never drawn
+
+
+def test_sampling_deterministic_in_key():
+    ds = Synthetic(num_clients=4, train_size=64, cache=False).get_dls()
+    a = ds.sample_round(jax.random.PRNGKey(5), 2, 4)
+    b = ds.sample_round(jax.random.PRNGKey(5), 2, 4)
+    np.testing.assert_array_equal(a[0], b[0])
+    c = ds.sample_round(jax.random.PRNGKey(6), 2, 4)
+    assert not np.array_equal(a[1], c[1])
+
+
+def test_synthetic_learnable_signal():
+    ds = Synthetic(num_clients=2, train_size=200, noise=0.1, cache=False).get_dls()
+    assert ds.train_x.shape[2:] == (28, 28, 1)
+    assert int(ds.test_y.max()) <= 9
+
+
+def test_custom_tensor_dataset():
+    x = np.random.randn(60, 4).astype(np.float32)
+    y = (np.arange(60) % 3).astype(np.int32)
+    ds = CustomTensorDataset(x, y, num_clients=6, iid=True)
+    fl = ds.get_dls()
+    assert fl.num_clients == 6
+    assert ds.num_classes == 3
+
+
+def test_augment_shapes_and_normalize():
+    key = jax.random.PRNGKey(0)
+    img = jnp.asarray(np.random.randint(0, 256, (32, 32, 3), np.uint8))
+    out = cifar_train_transform(key, img)
+    assert out.shape == (32, 32, 3)
+    norm = make_normalizer((0.5, 0.5, 0.5), (0.5, 0.5, 0.5))
+    z = norm(img)
+    assert z.dtype == jnp.float32
+    assert abs(float(z.mean())) < 0.2  # roughly centered
+
+
+def test_hflip_is_flip():
+    img = jnp.arange(12.0).reshape(2, 2, 3)
+    flipped = random_hflip(jax.random.PRNGKey(0), img, p=1.0)
+    np.testing.assert_array_equal(flipped, img[:, ::-1, :])

@@ -1,0 +1,79 @@
+"""LEAF utilities tests (pure python; no jax needed)."""
+
+import json
+
+import pytest
+
+from blades_tpu.leaf import iid_divide
+from blades_tpu.leaf.remove_users import remove_small_users
+from blades_tpu.leaf.sample import sample_leaf
+from blades_tpu.leaf.split_data import split_leaf
+from blades_tpu.leaf.stats import leaf_stats
+from blades_tpu.leaf.util import read_leaf_dir, write_leaf_json
+
+
+@pytest.fixture
+def leaf_data(tmp_path):
+    data = {
+        "users": [f"u{i}" for i in range(5)],
+        "num_samples": [4, 8, 12, 16, 20],
+        "user_data": {
+            f"u{i}": {
+                "x": [[float(i), float(j)] for j in range(4 * (i + 1))],
+                "y": [j % 2 for j in range(4 * (i + 1))],
+            }
+            for i in range(5)
+        },
+    }
+    write_leaf_json(data, str(tmp_path / "all.json"))
+    return data, tmp_path
+
+
+def test_iid_divide_even_and_ragged():
+    assert iid_divide(list(range(10)), 2) == [list(range(5)), list(range(5, 10))]
+    groups = iid_divide(list(range(11)), 3)
+    assert sorted(sum(groups, [])) == list(range(11))
+    assert {len(g) for g in groups} <= {3, 4}
+
+
+def test_read_write_roundtrip(leaf_data):
+    data, tmp = leaf_data
+    loaded = read_leaf_dir(str(tmp))
+    assert loaded["users"] == data["users"]
+    assert sum(loaded["num_samples"]) == 60
+
+
+def test_sample_noniid_budget(leaf_data):
+    data, _ = leaf_data
+    out = sample_leaf(data, fraction=0.5, iid=False, seed=1)
+    assert sum(out["num_samples"]) >= 0.5 * 60
+    for u in out["users"]:
+        assert out["user_data"][u] == data["user_data"][u]
+
+
+def test_sample_iid_pools(leaf_data):
+    data, _ = leaf_data
+    out = sample_leaf(data, fraction=0.5, iid=True, iid_user_frac=0.5, seed=1)
+    assert sum(out["num_samples"]) == 30
+    assert len(out["users"]) == 2
+
+
+def test_split_preserves_samples(leaf_data):
+    data, _ = leaf_data
+    train, test = split_leaf(data, frac=0.75, seed=0)
+    assert sum(train["num_samples"]) + sum(test["num_samples"]) == 60
+    assert sum(train["num_samples"]) >= 0.7 * 60
+
+
+def test_remove_small_users(leaf_data):
+    data, _ = leaf_data
+    out = remove_small_users(data, min_samples=10)
+    assert out["users"] == ["u2", "u3", "u4"]
+
+
+def test_stats(leaf_data):
+    data, _ = leaf_data
+    s = leaf_stats(data)
+    assert s["num_users"] == 5
+    assert s["num_samples"] == 60
+    assert s["min"] == 4 and s["max"] == 20

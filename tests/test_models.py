@@ -1,0 +1,82 @@
+"""Model-zoo tests: shapes, param-count parity, gradient flow, determinism."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.models import MODELS, build_fns, create_model
+from blades_tpu.ops.pytree import flat_dim
+
+SHAPES = {
+    "mlp": (28, 28, 1),
+    "cct_2_3x2_32": (32, 32, 3),
+    "cvt_7_4_32": (32, 32, 3),
+    "vit_lite_7_4_32": (32, 32, 3),
+    "resnet18": (32, 32, 3),
+    "wrn_28_10": (32, 32, 3),
+}
+
+
+@pytest.mark.parametrize("name", ["mlp", "cct_2_3x2_32", "resnet18"])
+def test_forward_backward(name):
+    spec = build_fns(create_model(name), SHAPES[name])
+    p = spec.init(jax.random.PRNGKey(0))
+    x = jnp.zeros((2,) + SHAPES[name])
+    y = jnp.array([0, 1])
+    logits = spec.eval_logits_fn(p, x)
+    assert logits.shape == (2, 10)
+    (loss, aux), g = jax.value_and_grad(
+        lambda pp: spec.train_loss_fn(pp, x, y, jax.random.PRNGKey(1)),
+        has_aux=True,
+    )(p)
+    assert np.isfinite(float(loss))
+    assert 0.0 <= float(aux["top1"]) <= 1.0
+    gnorm = sum(float(jnp.abs(l).sum()) for l in jax.tree_util.tree_leaves(g))
+    assert gnorm > 0
+
+
+def test_mlp_architecture_parity():
+    """784->64->128->10 log_softmax (reference dnn.py:5-19)."""
+    spec = build_fns(create_model("mlp"), (28, 28, 1))
+    p = spec.init(jax.random.PRNGKey(0))
+    expect = 784 * 64 + 64 + 64 * 128 + 128 + 128 * 10 + 10
+    assert flat_dim(p) == expect
+    logits = spec.eval_logits_fn(p, jnp.zeros((1, 28, 28, 1)))
+    # log_softmax output: logsumexp == 0
+    assert abs(float(jax.scipy.special.logsumexp(logits, axis=-1)[0])) < 1e-5
+
+
+def test_cct2_param_count_parity():
+    """cct_2_3x2_32 is ~284K params in the reference zoo."""
+    spec = build_fns(create_model("cct_2_3x2_32"), (32, 32, 3))
+    d = flat_dim(spec.init(jax.random.PRNGKey(0)))
+    assert 270_000 < d < 300_000, d
+
+
+def test_dropout_train_vs_eval():
+    spec = build_fns(create_model("cct_2_3x2_32"), (32, 32, 3))
+    p = spec.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 32, 32, 3))
+    e1 = spec.eval_logits_fn(p, x)
+    e2 = spec.eval_logits_fn(p, x)
+    np.testing.assert_array_equal(e1, e2)  # eval deterministic
+    y = jnp.zeros(4, jnp.int32)
+    l1, _ = spec.train_loss_fn(p, x, y, jax.random.PRNGKey(3))
+    l2, _ = spec.train_loss_fn(p, x, y, jax.random.PRNGKey(4))
+    assert float(l1) != float(l2)  # train stochastic (dropout/droppath)
+
+
+def test_registry_complete():
+    for name in ["mlp", "cct", "cctnet", "resnet18", "wrn_28_10", "cvt_7_4_32"]:
+        assert name in MODELS
+    with pytest.raises(ValueError):
+        create_model("nope")
+
+
+def test_wrn_and_cvt_build():
+    for name in ["cvt_7_4_32", "vit_lite_7_4_32"]:
+        spec = build_fns(create_model(name), (32, 32, 3))
+        p = spec.init(jax.random.PRNGKey(0))
+        out = spec.eval_logits_fn(p, jnp.zeros((1, 32, 32, 3)))
+        assert out.shape == (1, 10)
