@@ -212,16 +212,23 @@ class RoundEngine:
             attack_state=attack_state,
             round_idx=jnp.asarray(0, jnp.int32),
         )
-        if self.plan is not None:
-            state = state._replace(
-                params=self.plan.replicate(state.params),
-                client_opt_state=jax.device_put(
-                    state.client_opt_state, self.plan.clients
-                )
-                if self.client_opt.persist
-                else (),
+        return self.place_state(state)
+
+    def place_state(self, state: RoundState) -> RoundState:
+        """Lay out a RoundState per the sharding plan. Also used after
+        checkpoint restore so the resumed state has the same shardings (and
+        therefore the same compiled executable, bit-exactly) as a live one."""
+        if self.plan is None:
+            return state
+        return state._replace(
+            params=self.plan.replicate(state.params),
+            server_opt_state=self.plan.replicate(state.server_opt_state),
+            client_opt_state=jax.device_put(
+                state.client_opt_state, self.plan.clients
             )
-        return state
+            if self.client_opt.persist
+            else (),
+        )
 
     # -- the round program ---------------------------------------------------
 

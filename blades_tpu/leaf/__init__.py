@@ -6,10 +6,10 @@ split, stats, user pruning — SURVEY.md C11). Same JSON schema
 (``{"users": [...], "num_samples": [...], "user_data": {u: {"x": [...],
 "y": [...]}}}``), same CLI entry points, re-implemented compactly:
 
-    python -m blades_tpu.leaf.sample --data-dir D --out-dir O --fraction 0.1
+    python -m blades_tpu.leaf.sample --data-dir D --out-file F --fraction 0.1
     python -m blades_tpu.leaf.split_data --data-dir D --out-dir O --frac 0.9
     python -m blades_tpu.leaf.stats --data-dir D
-    python -m blades_tpu.leaf.remove_users --data-dir D --out-dir O --min-samples 10
+    python -m blades_tpu.leaf.remove_users --data-dir D --out-file F --min-samples 10
 
 (The reference's GDrive ``download_util.py`` is intentionally absent: this
 build performs no network downloads.)

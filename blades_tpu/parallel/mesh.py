@@ -25,6 +25,7 @@ no-op because the replicated params never leave the device.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Optional, Sequence
 
 import jax
@@ -54,6 +55,14 @@ def make_mesh(
         raise ValueError(f"mesh_shape {mesh_shape} != {n} devices")
     dev_array = np.asarray(devices).reshape(mesh_shape)
     return Mesh(dev_array, (CLIENTS_AXIS, MODEL_AXIS))
+
+
+def auto_mesh_shape(n_devices: int, num_clients: int) -> tuple:
+    """Largest clients-axis width that divides both the device count and K
+    (explicit ``device_put`` sharding requires even divisibility); leftover
+    devices go to the ``model`` axis."""
+    c = math.gcd(n_devices, num_clients)
+    return (c, n_devices // c)
 
 
 @dataclasses.dataclass(frozen=True)

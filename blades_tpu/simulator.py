@@ -34,7 +34,7 @@ from blades_tpu.core.engine import multistep_lr
 from blades_tpu.datasets.base import BaseDataset
 from blades_tpu.datasets.fl import FLDataset
 from blades_tpu.models.common import ModelSpec, build_fns
-from blades_tpu.parallel.mesh import make_mesh, make_plan
+from blades_tpu.parallel.mesh import auto_mesh_shape, make_mesh, make_plan
 from blades_tpu.server import BladesServer
 from blades_tpu.utils.logging import initialize_logger
 from blades_tpu.utils.metrics import top1_accuracy
@@ -154,6 +154,8 @@ class Simulator:
         # device mesh: shard whenever >1 device is visible
         devices = jax.devices()
         if len(devices) > 1 or mesh_shape is not None:
+            if mesh_shape is None:
+                mesh_shape = auto_mesh_shape(len(devices), k)
             self.plan = make_plan(make_mesh(devices, mesh_shape))
         else:
             self.plan = None
@@ -289,10 +291,12 @@ class Simulator:
         state = self.engine.init(params)
 
         start_round = 1
-        if resume and checkpoint_path and os.path.exists(checkpoint_path):
+        from blades_tpu.utils.checkpoint import checkpoint_file
+
+        if resume and checkpoint_path and os.path.exists(checkpoint_file(checkpoint_path)):
             from blades_tpu.utils.checkpoint import restore_state
 
-            state = restore_state(checkpoint_path, state)
+            state = self.engine.place_state(restore_state(checkpoint_path, state))
             start_round = int(state.round_idx) + 1
             self.debug_logger.info(f"resumed from {checkpoint_path} at round {start_round}")
         self.server = BladesServer(self.engine, state, self.aggregator)
@@ -303,9 +307,15 @@ class Simulator:
         data_key = jax.random.fold_in(key, 23)
         round_times: List[float] = []
         global_start = time.time()
+        # profile a ~3-round window, skipping the round-1 compile when the
+        # run is long enough to allow it
+        prof_first = min(max(start_round, 2), global_rounds)
+        prof_last = min(prof_first + 2, global_rounds)
+        trace_active = False
         for rnd in range(start_round, global_rounds + 1):
-            if profile_dir and rnd == 2:
+            if profile_dir and rnd == prof_first:
                 jax.profiler.start_trace(profile_dir)
+                trace_active = True
             round_start = time.time()
             cx, cy = self.dataset.sample_round(
                 jax.random.fold_in(data_key, rnd), local_steps, batch_size
@@ -328,9 +338,10 @@ class Simulator:
                     f"Test global round {rnd}, loss: {ev['Loss']}, top1: {ev['top1']}"
                 )
 
-            if profile_dir and rnd == min(4, global_rounds):
+            if trace_active and rnd == prof_last:
                 jax.block_until_ready(state.params)
                 jax.profiler.stop_trace()
+                trace_active = False
             if (
                 checkpoint_path
                 and checkpoint_interval

@@ -21,31 +21,55 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _flatten_with_paths(tree: Any):
-    flat, treedef = jax.tree_util.tree_flatten(tree)
-    return flat, treedef
+def checkpoint_file(path: str) -> str:
+    """The on-disk filename for ``path`` (``np.savez`` appends ``.npz`` to
+    extension-less paths, so every consumer must normalize the same way)."""
+    return path if path.endswith(".npz") else path + ".npz"
 
 
 def save_state(path: str, state: Any) -> None:
-    """Serialize a pytree (e.g. RoundState) to ``path`` (.npz)."""
-    flat, treedef = _flatten_with_paths(state)
+    """Serialize a pytree (e.g. RoundState) to ``checkpoint_file(path)``."""
+    path = checkpoint_file(path)
+    flat, treedef = jax.tree_util.tree_flatten(state)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(flat)}
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    np.savez(path, __treedef__=np.frombuffer(str(treedef).encode(), np.uint8), **arrays)
+    np.savez(
+        path,
+        __treedef__=np.frombuffer(str(treedef).encode(), np.uint8),
+        __num_leaves__=np.asarray(len(flat)),
+        **arrays,
+    )
 
 
 def restore_state(path: str, like: Any) -> Any:
     """Restore a pytree saved by :func:`save_state`. ``like`` supplies the
-    tree structure (e.g. a freshly built RoundState); leaf dtypes/shapes must
-    match what was saved."""
-    z = np.load(path)
+    tree structure (e.g. a freshly built RoundState); the saved treedef,
+    leaf count, shapes, and dtypes must all match it."""
+    z = np.load(checkpoint_file(path))
     flat_like, treedef = jax.tree_util.tree_flatten(like)
-    n = len(flat_like)
-    flat = [jnp.asarray(z[f"leaf_{i}"]) for i in range(n)]
+    saved_n = int(z["__num_leaves__"]) if "__num_leaves__" in z else None
+    if saved_n is not None and saved_n != len(flat_like):
+        raise ValueError(
+            f"checkpoint has {saved_n} leaves but the current engine state "
+            f"has {len(flat_like)} — incompatible config (e.g. persist/"
+            "aggregator/attack mismatch)?"
+        )
+    saved_treedef = bytes(z["__treedef__"]).decode()
+    if saved_treedef != str(treedef):
+        raise ValueError(
+            "checkpoint tree structure differs from the current engine "
+            f"state:\n  saved:   {saved_treedef}\n  current: {treedef}"
+        )
+    flat = [jnp.asarray(z[f"leaf_{i}"]) for i in range(len(flat_like))]
     for i, (new, old) in enumerate(zip(flat, flat_like)):
         if jnp.shape(new) != jnp.shape(old):
             raise ValueError(
                 f"checkpoint leaf {i} shape {jnp.shape(new)} != expected "
                 f"{jnp.shape(old)} — incompatible config?"
+            )
+        if new.dtype != jnp.asarray(old).dtype:
+            raise ValueError(
+                f"checkpoint leaf {i} dtype {new.dtype} != expected "
+                f"{jnp.asarray(old).dtype} — incompatible config?"
             )
     return jax.tree_util.tree_unflatten(treedef, flat)

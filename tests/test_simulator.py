@@ -59,7 +59,7 @@ def test_run_writes_stats_log(tmp_path):
 def test_learning_happens(tmp_path):
     sim = _sim(tmp_path, aggregator="mean")
     sim.run("mlp", global_rounds=15, local_steps=2, client_lr=0.5,
-            validate_interval=15, train_batch_size=16)
+            server_lr=1.0, validate_interval=15, train_batch_size=16)
     ev = sim.evaluate(15, 64)
     assert ev["top1"] > 0.3
 
