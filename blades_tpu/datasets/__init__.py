@@ -15,6 +15,7 @@ generators, no host round-trips.
 from blades_tpu.datasets.fl import FLDataset
 from blades_tpu.datasets.base import BaseDataset, partition_iid, partition_dirichlet
 from blades_tpu.datasets.synthetic import Synthetic
+from blades_tpu.datasets.text import SyntheticText
 from blades_tpu.datasets.mnist import MNIST
 from blades_tpu.datasets.cifar10 import CIFAR10
 from blades_tpu.datasets.cifar100 import CIFAR100
@@ -26,6 +27,7 @@ __all__ = [
     "partition_iid",
     "partition_dirichlet",
     "Synthetic",
+    "SyntheticText",
     "MNIST",
     "CIFAR10",
     "CIFAR100",

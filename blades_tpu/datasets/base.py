@@ -75,6 +75,7 @@ class BaseDataset:
 
     name: str = "base"
     num_classes: int = 10
+    pad_id: Optional[int] = None  # text datasets: id of the padding token
 
     def __init__(
         self,
@@ -168,5 +169,6 @@ class BaseDataset:
                 test_y,
                 transform=self.make_transform(),
                 normalize=self.make_normalize(),
+                pad_id=self.pad_id,
             )
         return self._fl

@@ -48,6 +48,7 @@ class FLDataset:
         transform: Optional[Callable] = None,
         normalize: Optional[Callable] = None,
         client_ids: Optional[List] = None,
+        pad_id: Optional[int] = None,
     ):
         self.train_x = jnp.asarray(train_x)
         self.train_y = jnp.asarray(train_y)
@@ -56,6 +57,9 @@ class FLDataset:
         self.test_y = jnp.asarray(test_y)
         self.transform = transform
         self.normalize = normalize
+        # token id marking padded text positions (None for image data);
+        # consumed by the model adapter to build attention masks
+        self.pad_id = pad_id
         self.num_clients = int(self.train_x.shape[0])
         self.client_ids = (
             list(client_ids) if client_ids is not None else list(range(self.num_clients))

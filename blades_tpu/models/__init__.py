@@ -28,6 +28,21 @@ from blades_tpu.models.cct import (
     CCTNet,
 )
 from blades_tpu.models.resnet import ResNet18, ResNet34
+from blades_tpu.models.text import (
+    TextCCT,
+    text_cct_2,
+    text_cct_4,
+    text_cct_6,
+    text_cvt_2,
+    text_cvt_4,
+    text_cvt_6,
+    text_vit_2,
+    text_vit_4,
+    text_vit_6,
+    text_transformer_2,
+    text_transformer_4,
+    text_transformer_6,
+)
 from blades_tpu.models.wrn import WideResNet, wrn_28_10
 
 MODELS: Dict[str, Callable] = {
@@ -43,6 +58,18 @@ MODELS: Dict[str, Callable] = {
     "resnet18": lambda num_classes=10, **kw: ResNet18(num_classes=num_classes),
     "resnet34": lambda num_classes=10, **kw: ResNet34(num_classes=num_classes),
     "wrn_28_10": wrn_28_10,
+    "text_cct_2": text_cct_2,
+    "text_cct_4": text_cct_4,
+    "text_cct_6": text_cct_6,
+    "text_cvt_2": text_cvt_2,
+    "text_cvt_4": text_cvt_4,
+    "text_cvt_6": text_cvt_6,
+    "text_vit_2": text_vit_2,
+    "text_vit_4": text_vit_4,
+    "text_vit_6": text_vit_6,
+    "text_transformer_2": text_transformer_2,
+    "text_transformer_4": text_transformer_4,
+    "text_transformer_6": text_transformer_6,
 }
 
 
@@ -75,4 +102,17 @@ __all__ = [
     "ResNet34",
     "WideResNet",
     "wrn_28_10",
+    "TextCCT",
+    "text_cct_2",
+    "text_cct_4",
+    "text_cct_6",
+    "text_cvt_2",
+    "text_cvt_4",
+    "text_cvt_6",
+    "text_vit_2",
+    "text_vit_4",
+    "text_vit_6",
+    "text_transformer_2",
+    "text_transformer_4",
+    "text_transformer_6",
 ]

@@ -43,29 +43,38 @@ def build_fns(
     sample_shape: Tuple[int, ...],
     loss: str = "crossentropy",
     param_dtype=jnp.float32,
+    input_dtype=None,
+    pad_id: Optional[int] = None,
 ) -> ModelSpec:
     """Adapt a flax module to the engine's pure-function interface.
 
     ``loss='crossentropy'`` matches the reference's only supported loss
-    (``client.py:100-104`` raises for anything else).
+    (``client.py:100-104`` raises for anything else). ``input_dtype``
+    overrides the dummy-input dtype at init (int32 for token-id text models).
+    ``pad_id``: for text models — derive a validity mask ``x != pad_id`` and
+    pass it to the module so padded positions never influence attention or
+    pooling (the reference's mask plumbing, ``utils/embedder.py:23-28``).
     """
     if loss != "crossentropy":
         raise NotImplementedError(f"loss {loss!r} (reference parity: crossentropy only)")
 
+    def _kwargs(x):
+        return {"mask": x != pad_id} if pad_id is not None else {}
+
     def init(key: jax.Array):
-        dummy = jnp.zeros((1,) + tuple(sample_shape), param_dtype)
-        variables = module.init({"params": key}, dummy, train=False)
+        dummy = jnp.zeros((1,) + tuple(sample_shape), input_dtype or param_dtype)
+        variables = module.init({"params": key}, dummy, train=False, **_kwargs(dummy))
         return variables["params"]
 
     def train_loss_fn(params, x, y, key):
         logits = module.apply(
-            {"params": params}, x, train=True, rngs={"dropout": key}
+            {"params": params}, x, train=True, rngs={"dropout": key}, **_kwargs(x)
         )
         top1 = jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
         return cross_entropy(logits, y), {"top1": top1}
 
     def eval_logits_fn(params, x):
-        return module.apply({"params": params}, x, train=False)
+        return module.apply({"params": params}, x, train=False, **_kwargs(x))
 
     return ModelSpec(
         module=module,

@@ -1,0 +1,369 @@
+"""Text Compact Transformers (TextCCT / TextCVT / TextViT / Transformer-Lite).
+
+Reference: ``src/blades/models/cifar10/cctnets/text/`` — word ``Embedder``
+(``utils/embedder.py:4-37``), 1-D conv ``TextTokenizer``
+(``utils/tokenizer.py:52-120``), ``MaskedTransformerClassifier`` with
+pairwise-masked attention (``utils/transformers.py:39-71,235-322``), and the
+factory grids ``text_cct_{2,4,6}`` (``text/cct.py:74-86``),
+``text_cvt_{2,4,6}`` (``text/cvt.py:61-73``), ``text_vit_{2,4,6}``
+(``text/vit.py:61-73``), ``text_transformer_{2,4,6}``
+(``text/transformer.py:45-57``).
+
+Semantics kept: padded positions are zeroed after embedding and after the
+tokenizer; the token-level mask is propagated through the conv/pool exactly
+as a ones-kernel conv1d + maxpool of the float mask (> 0); attention logits
+get the pairwise mask ``m[:, None] & m[None, :]`` filled with -inf before
+softmax; class-token mode extends the mask with an always-valid slot.
+Deviation: the positional embedding is always sized to the *runtime* token
+sequence (the reference sizes sine tables with an extra padding row that
+cannot broadcast — a latent crash its no-test policy never caught).
+
+TPU notes: the tokenizer's (k x E) conv is expressed as a 1-D feature-mixing
+conv over NWC layout — one MXU matmul per window position; masking is
+elementwise ``jnp.where`` fused into the attention softmax by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from blades_tpu.models.cct import sinusoidal_embedding, _trunc02
+from blades_tpu.models.common import DropPath
+
+NEG_INF = -1e9  # mask fill for fp32/bf16 attention logits
+
+
+class Embedder(nn.Module):
+    """Word embedding table (reference ``utils/embedder.py:4-37``); padded
+    positions (mask == 0) are zeroed."""
+
+    vocab_size: int = 100_000
+    word_embedding_dim: int = 300
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, mask: Optional[jnp.ndarray] = None):
+        x = nn.Embed(
+            self.vocab_size,
+            self.word_embedding_dim,
+            embedding_init=nn.initializers.normal(1.0),
+        )(tokens)
+        if mask is not None:
+            x = x * mask[..., None].astype(x.dtype)
+        return x, mask
+
+
+class TextTokenizer(nn.Module):
+    """1-D conv tokenizer (reference ``utils/tokenizer.py:52-120``): a single
+    conv spanning the full embedding width, optional ReLU, optional 1-D
+    maxpool; the boolean mask rides along through the same receptive fields."""
+
+    kernel_size: int
+    stride: int
+    padding: int
+    n_output_channels: int = 128
+    max_pool: bool = True
+    use_act: bool = True
+    pooling_kernel_size: int = 3
+    pooling_stride: int = 2
+    pooling_padding: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, mask: Optional[jnp.ndarray] = None):
+        # [B, L, E] -> [B, L', C]: conv over the sequence axis, full-width in E
+        x = nn.Conv(
+            self.n_output_channels,
+            (self.kernel_size,),
+            strides=(self.stride,),
+            padding=[(self.padding, self.padding)],
+            use_bias=False,
+            kernel_init=nn.initializers.kaiming_normal(),
+        )(x)
+        if self.use_act:
+            x = nn.relu(x)
+        if self.max_pool:
+            x = nn.max_pool(
+                x,
+                (self.pooling_kernel_size,),
+                strides=(self.pooling_stride,),
+                padding=[(self.pooling_padding,) * 2],
+            )
+        if mask is not None:
+            mask = self._forward_mask(mask)
+            x = x * mask[..., None].astype(x.dtype)
+        return x, mask
+
+    def _forward_mask(self, mask: jnp.ndarray) -> jnp.ndarray:
+        """Ones-kernel conv1d + maxpool of the float mask, thresholded > 0
+        (reference ``tokenizer.py:78-95``): a token survives if any source
+        position in its receptive field was valid."""
+        m = mask.astype(jnp.float32)[..., None]  # [B, L, 1]
+        ones = jnp.ones((self.kernel_size, 1, 1), jnp.float32)
+        m = jax.lax.conv_general_dilated(
+            m,
+            ones,
+            window_strides=(self.stride,),
+            padding=[(self.padding, self.padding)],
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        if self.max_pool:
+            m = nn.max_pool(
+                m,
+                (self.pooling_kernel_size,),
+                strides=(self.pooling_stride,),
+                padding=[(self.pooling_padding,) * 2],
+            )
+        return m[..., 0] > 0
+
+    def seq_len(self, seq_len: int) -> int:
+        n = (seq_len + 2 * self.padding - self.kernel_size) // self.stride + 1
+        if self.max_pool:
+            n = (
+                n + 2 * self.pooling_padding - self.pooling_kernel_size
+            ) // self.pooling_stride + 1
+        return n
+
+
+class MaskedAttention(nn.Module):
+    """MHSA with pairwise key/query masking (reference
+    ``utils/transformers.py:39-71``)."""
+
+    dim: int
+    num_heads: int
+    attention_dropout: float = 0.1
+    projection_dropout: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        b, n, c = x.shape
+        head_dim = self.dim // self.num_heads
+        qkv = nn.Dense(self.dim * 3, use_bias=False, kernel_init=_trunc02)(x)
+        qkv = qkv.reshape(b, n, 3, self.num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = jnp.einsum("bnhd,bmhd->bhnm", q, k) * (head_dim**-0.5)
+        if mask is not None:
+            pair = mask[:, :, None] & mask[:, None, :]  # [B, N, N]
+            attn = jnp.where(pair[:, None], attn, NEG_INF)
+        attn = jax.nn.softmax(attn, axis=-1)
+        attn = nn.Dropout(self.attention_dropout)(attn, deterministic=deterministic)
+        out = jnp.einsum("bhnm,bmhd->bnhd", attn, v).reshape(b, n, c)
+        out = nn.Dense(self.dim, kernel_init=_trunc02)(out)
+        return nn.Dropout(self.projection_dropout)(out, deterministic=deterministic)
+
+
+class MaskedTransformerEncoderLayer(nn.Module):
+    """Pre-norm block, residual wiring as the image variant
+    (``utils/transformers.py:74-103``) plus the mask pass-through."""
+
+    d_model: int
+    nhead: int
+    dim_feedforward: int
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    drop_path_rate: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        h = MaskedAttention(
+            self.d_model, self.nhead, self.attention_dropout, self.dropout
+        )(nn.LayerNorm()(x), mask=mask, deterministic=deterministic)
+        x = x + DropPath(self.drop_path_rate)(h, deterministic=deterministic)
+        x = nn.LayerNorm()(x)
+        h = nn.Dense(self.dim_feedforward, kernel_init=_trunc02)(x)
+        h = nn.Dropout(self.dropout)(nn.gelu(h), deterministic=deterministic)
+        h = nn.Dense(self.d_model, kernel_init=_trunc02)(h)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        return x + DropPath(self.drop_path_rate)(h, deterministic=deterministic)
+
+
+class TextCCT(nn.Module):
+    """Unified text classifier covering the reference's four text families:
+
+    - ``text_cct_*``: conv tokenizer (ReLU + maxpool) + seq-pool
+    - ``text_cvt_*``: patchify tokenizer (no act/pool) + seq-pool
+    - ``text_vit_*``: patchify tokenizer + class token
+    - ``text_transformer_*``: no tokenizer (word embeddings straight into
+      the encoder) + class token
+    """
+
+    num_classes: int = 2
+    seq_len: Optional[int] = None  # if set, input length is validated
+    vocab_size: int = 100_000
+    word_embedding_dim: int = 300
+    embedding_dim: int = 128
+    num_layers: int = 2
+    num_heads: int = 2
+    mlp_ratio: float = 1.0
+    kernel_size: int = 4
+    stride: Optional[int] = None
+    padding: Optional[int] = None
+    use_tokenizer: bool = True
+    max_pool: bool = True
+    use_act: bool = True
+    seq_pool: bool = True
+    dropout: float = 0.0
+    attention_dropout: float = 0.1
+    stochastic_depth: float = 0.1
+    positional_embedding: str = "sine"  # sine | learnable | none
+
+    @nn.compact
+    def __call__(self, tokens, mask=None, train: bool = False):
+        det = not train
+        if self.seq_len is not None and tokens.shape[1] != self.seq_len:
+            raise ValueError(
+                f"input length {tokens.shape[1]} != configured seq_len "
+                f"{self.seq_len}"
+            )
+        x, mask = Embedder(self.vocab_size, self.word_embedding_dim)(tokens, mask)
+        if self.use_tokenizer:
+            stride = (
+                self.stride
+                if self.stride is not None
+                else max(1, (self.kernel_size // 2) - 1)
+            )
+            padding = (
+                self.padding
+                if self.padding is not None
+                else max(1, self.kernel_size // 2)
+            )
+            x, mask = TextTokenizer(
+                kernel_size=self.kernel_size,
+                stride=stride,
+                padding=padding,
+                n_output_channels=self.embedding_dim,
+                max_pool=self.max_pool,
+                use_act=self.use_act,
+            )(x, mask)
+
+        if not self.seq_pool:
+            cls = self.param(
+                "class_emb", nn.initializers.zeros, (1, 1, x.shape[-1])
+            )
+            x = jnp.concatenate([jnp.tile(cls, (x.shape[0], 1, 1)), x], axis=1)
+            if mask is not None:
+                mask = jnp.concatenate(
+                    [jnp.ones((mask.shape[0], 1), bool), mask], axis=1
+                )
+        n = x.shape[1]
+
+        if self.positional_embedding == "learnable":
+            pe = self.param(
+                "positional_emb",
+                nn.initializers.truncated_normal(stddev=0.2),
+                (1, n, x.shape[-1]),
+            )
+            x = x + pe
+        elif self.positional_embedding == "sine":
+            x = x + sinusoidal_embedding(n, x.shape[-1])
+
+        x = nn.Dropout(self.dropout)(x, deterministic=det)
+        dpr = [
+            self.stochastic_depth * i / max(self.num_layers - 1, 1)
+            for i in range(self.num_layers)
+        ]
+        for i in range(self.num_layers):
+            x = MaskedTransformerEncoderLayer(
+                d_model=x.shape[-1],
+                nhead=self.num_heads,
+                dim_feedforward=int(x.shape[-1] * self.mlp_ratio),
+                dropout=self.dropout,
+                attention_dropout=self.attention_dropout,
+                drop_path_rate=dpr[i],
+            )(x, mask=mask, deterministic=det)
+        x = nn.LayerNorm()(x)
+
+        if self.seq_pool:
+            w = nn.Dense(1, kernel_init=_trunc02)(x)  # [B, N, 1]
+            if mask is not None:
+                w = jnp.where(mask[..., None], w, NEG_INF)
+            w = jax.nn.softmax(w, axis=1)
+            x = jnp.einsum("bnl,bnc->bc", w, x)
+        else:
+            x = x[:, 0]
+        return nn.Dense(self.num_classes, kernel_init=_trunc02)(x)
+
+
+# -- factories (reference text/{cct,cvt,vit,transformer}.py grids) ------------
+
+_GRID = {2: (2, 2, 1.0, 128), 4: (4, 2, 1.0, 128), 6: (6, 4, 2.0, 256)}
+
+
+def _text(kind: str, depth: int, num_classes: int = 2, **kw) -> TextCCT:
+    layers, heads, ratio, dim = _GRID[depth]
+    cfg = dict(
+        num_classes=num_classes,
+        num_layers=layers,
+        num_heads=heads,
+        mlp_ratio=ratio,
+        embedding_dim=dim,
+    )
+    if kind == "cct":
+        cfg.update(kernel_size=4, max_pool=True, use_act=True, seq_pool=True)
+    elif kind == "cvt":
+        # patchify: kernel=stride=patch_size, no pad/act/pool (text/cvt.py:27-33)
+        cfg.update(
+            kernel_size=4, stride=4, padding=0,
+            max_pool=False, use_act=False, seq_pool=True,
+        )
+    elif kind == "vit":
+        cfg.update(
+            kernel_size=4, stride=4, padding=0,
+            max_pool=False, use_act=False, seq_pool=False,
+        )
+    elif kind == "transformer":
+        # no tokenizer: encoder width = word embedding dim (text/transformer.py:22-28)
+        cfg.update(use_tokenizer=False, seq_pool=False)
+        cfg.pop("embedding_dim")
+    cfg.update(kw)
+    return TextCCT(**cfg)
+
+
+def text_cct_2(num_classes: int = 2, **kw) -> TextCCT:
+    return _text("cct", 2, num_classes, **kw)
+
+
+def text_cct_4(num_classes: int = 2, **kw) -> TextCCT:
+    return _text("cct", 4, num_classes, **kw)
+
+
+def text_cct_6(num_classes: int = 2, **kw) -> TextCCT:
+    return _text("cct", 6, num_classes, **kw)
+
+
+def text_cvt_2(num_classes: int = 2, **kw) -> TextCCT:
+    return _text("cvt", 2, num_classes, **kw)
+
+
+def text_cvt_4(num_classes: int = 2, **kw) -> TextCCT:
+    return _text("cvt", 4, num_classes, **kw)
+
+
+def text_cvt_6(num_classes: int = 2, **kw) -> TextCCT:
+    return _text("cvt", 6, num_classes, **kw)
+
+
+def text_vit_2(num_classes: int = 2, **kw) -> TextCCT:
+    return _text("vit", 2, num_classes, **kw)
+
+
+def text_vit_4(num_classes: int = 2, **kw) -> TextCCT:
+    return _text("vit", 4, num_classes, **kw)
+
+
+def text_vit_6(num_classes: int = 2, **kw) -> TextCCT:
+    return _text("vit", 6, num_classes, **kw)
+
+
+def text_transformer_2(num_classes: int = 2, **kw) -> TextCCT:
+    return _text("transformer", 2, num_classes, **kw)
+
+
+def text_transformer_4(num_classes: int = 2, **kw) -> TextCCT:
+    return _text("transformer", 4, num_classes, **kw)
+
+
+def text_transformer_6(num_classes: int = 2, **kw) -> TextCCT:
+    return _text("transformer", 6, num_classes, **kw)

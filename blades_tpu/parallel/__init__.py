@@ -2,6 +2,8 @@ from blades_tpu.parallel.mesh import (  # noqa: F401
     CLIENTS_AXIS,
     MODEL_AXIS,
     ShardingPlan,
+    auto_mesh_shape,
     make_mesh,
     make_plan,
 )
+from blades_tpu.parallel import distributed  # noqa: F401

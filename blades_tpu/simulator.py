@@ -36,6 +36,7 @@ from blades_tpu.datasets.fl import FLDataset
 from blades_tpu.models.common import ModelSpec, build_fns
 from blades_tpu.parallel.mesh import auto_mesh_shape, make_mesh, make_plan
 from blades_tpu.server import BladesServer
+from blades_tpu.utils.checkpoint import checkpoint_file, restore_state, save_state
 from blades_tpu.utils.logging import initialize_logger
 from blades_tpu.utils.metrics import top1_accuracy
 
@@ -256,8 +257,10 @@ class Simulator:
         ``checkpoint_path``/``checkpoint_interval``/``resume``: save the full
         round state every N rounds and resume bit-exactly (absent in the
         reference, SURVEY.md section 5). ``profile_dir``: capture a
-        ``jax.profiler`` trace of rounds 2-4. ``client_chunks``/``remat``:
-        HBM control for large populations (see RoundEngine).
+        ``jax.profiler`` trace of a ~3-round window starting at the first
+        post-compile round of this run (round 2, or the resume round).
+        ``client_chunks``/``remat``: HBM control for large populations (see
+        RoundEngine).
         """
         spec = self._model_spec(model, loss)
         batch_size = train_batch_size or self._train_bs
@@ -291,11 +294,7 @@ class Simulator:
         state = self.engine.init(params)
 
         start_round = 1
-        from blades_tpu.utils.checkpoint import checkpoint_file
-
         if resume and checkpoint_path and os.path.exists(checkpoint_file(checkpoint_path)):
-            from blades_tpu.utils.checkpoint import restore_state
-
             state = self.engine.place_state(restore_state(checkpoint_path, state))
             start_round = int(state.round_idx) + 1
             self.debug_logger.info(f"resumed from {checkpoint_path} at round {start_round}")
@@ -347,8 +346,6 @@ class Simulator:
                 and checkpoint_interval
                 and rnd % checkpoint_interval == 0
             ):
-                from blades_tpu.utils.checkpoint import save_state
-
                 save_state(checkpoint_path, state)
 
             round_times.append(time.time() - round_start)
@@ -366,7 +363,19 @@ class Simulator:
             from blades_tpu.models import create_model
 
             model = create_model(model, num_classes=self._num_classes)
-        return build_fns(model, sample_shape, loss=loss or "crossentropy")
+        # model inputs are whatever the dataset feeds the engine: post-
+        # normalize floats for images, raw int token ids for text
+        x0 = self.dataset.train_x[:1, :1]
+        if self.dataset.normalize is not None:
+            x0 = self.dataset.normalize(x0)
+        input_dtype = jnp.int32 if jnp.issubdtype(x0.dtype, jnp.integer) else x0.dtype
+        return build_fns(
+            model,
+            sample_shape,
+            loss=loss or "crossentropy",
+            input_dtype=input_dtype,
+            pad_id=getattr(self.dataset, "pad_id", None),
+        )
 
     # -- logging (stats-file schema parity, simulator.py:309-362) -------------
 

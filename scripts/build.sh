@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Build + locally install the wheel (reference: scripts/build.sh).
+set -e
+pushd "$(dirname "$0")/.." >/dev/null
+  python3 setup.py sdist bdist_wheel
+  pushd dist >/dev/null
+    pip uninstall -y blades-tpu || true
+    pip install --force-reinstall blades_tpu-*-py3-none-any.whl
+  popd >/dev/null
+popd >/dev/null

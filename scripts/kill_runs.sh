@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Kill stray experiment runs (reference: scripts/kill_cifar.sh).
+pgrep -f "scripts/cifar10.py" | xargs -r kill -9

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Cut a release build (reference: scripts/release.sh). Upload deliberately
+# manual: run `python3 -m twine upload dist/*` yourself.
+set -e
+pushd "$(dirname "$0")/.." >/dev/null
+  rm -rf build dist blades_tpu.egg-info
+  python3 setup.py sdist bdist_wheel
+popd >/dev/null

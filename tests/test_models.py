@@ -80,3 +80,88 @@ def test_wrn_and_cvt_build():
         p = spec.init(jax.random.PRNGKey(0))
         out = spec.eval_logits_fn(p, jnp.zeros((1, 32, 32, 3)))
         assert out.shape == (1, 10)
+
+
+# -- text family (reference cctnets/text/, masked transformers) ---------------
+
+
+def _text_spec(factory, seq_len=16, vocab=50, **kw):
+    from blades_tpu.models import common
+
+    module = factory(num_classes=2, seq_len=seq_len, vocab_size=vocab, **kw)
+    return common.build_fns(module, (seq_len,), input_dtype=jnp.int32)
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["text_cct_2", "text_cvt_2", "text_vit_2", "text_transformer_2"],
+)
+def test_text_forward_backward(name):
+    from blades_tpu.models import MODELS
+
+    spec = _text_spec(MODELS[name])
+    p = spec.init(jax.random.PRNGKey(0))
+    x = jax.random.randint(jax.random.PRNGKey(1), (3, 16), 0, 50)
+    logits = spec.eval_logits_fn(p, x)
+    assert logits.shape == (3, 2)
+    y = jnp.array([0, 1, 0])
+    (loss, aux), g = jax.value_and_grad(
+        lambda pp: spec.train_loss_fn(pp, x, y, jax.random.PRNGKey(2)),
+        has_aux=True,
+    )(p)
+    assert np.isfinite(float(loss))
+    gnorm = sum(float(jnp.abs(l).sum()) for l in jax.tree_util.tree_leaves(g))
+    assert gnorm > 0
+
+
+@pytest.mark.parametrize("name", ["text_cct_2", "text_vit_2", "text_transformer_2"])
+def test_text_mask_invariance(name):
+    """Padded positions must not influence the logits when masked."""
+    from blades_tpu.models import MODELS
+
+    module = MODELS[name](num_classes=2, seq_len=12, vocab_size=40)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 12), 2, 40)
+    mask = jnp.arange(12)[None, :] < jnp.array([[7], [12]])  # first row padded
+    p = module.init(
+        {"params": jax.random.PRNGKey(0)}, tokens, mask=mask, train=False
+    )["params"]
+    out1 = module.apply({"params": p}, tokens, mask=mask, train=False)
+    # scramble the padded region; masked output must be identical
+    garbage = jnp.where(mask, tokens, (tokens * 7 + 3) % 40)
+    out2 = module.apply({"params": p}, garbage, mask=mask, train=False)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-5)
+
+
+def test_text_tokenizer_mask_matches_torch():
+    """Mask propagation == torch conv1d(ones)/maxpool1d thresholding
+    (reference tokenizer.py:78-95), cross-checked against torch directly."""
+    import torch
+    import torch.nn.functional as F
+
+    from blades_tpu.models.text import TextTokenizer
+
+    tok = TextTokenizer(kernel_size=4, stride=1, padding=2,
+                        n_output_channels=8, max_pool=True)
+    mask = np.zeros((3, 17), bool)
+    mask[0, :5] = True
+    mask[1, 3:11] = True
+    mask[2, :] = True
+    ours = tok._forward_mask(jnp.asarray(mask))
+
+    m = torch.tensor(mask, dtype=torch.float32).unsqueeze(1)
+    w = torch.ones((1, 1, 4))
+    ref = F.conv1d(m, w, None, 1, 2, 1, 1)
+    ref = F.max_pool1d(ref, 3, 2, 1, 1, False, False)
+    ref = (ref.squeeze(1) > 0).numpy()
+    np.testing.assert_array_equal(np.asarray(ours), ref)
+
+
+def test_text_seq_len_formula():
+    from blades_tpu.models.text import TextTokenizer
+
+    for k, s, pd, mp in [(4, 1, 2, True), (4, 4, 0, False), (2, 1, 1, True)]:
+        tok = TextTokenizer(kernel_size=k, stride=s, padding=pd,
+                            n_output_channels=4, max_pool=mp)
+        x = jnp.zeros((1, 64, 30))
+        out, _ = tok.init_with_output(jax.random.PRNGKey(0), x)
+        assert out[0].shape[1] == tok.seq_len(64), (k, s, pd, mp)
