@@ -31,6 +31,9 @@ BATCH = int(os.environ.get("BENCH_BATCH", 32))
 # sequential client chunks bound activation HBM (see RoundEngine docstring);
 # 10 chunks of 100 clients still push 3200 images per conv batch to the MXU
 CHUNKS = int(os.environ.get("BENCH_CHUNKS", 10))
+# bf16 forward/backward on the MXU (master weights fp32); set BENCH_BF16=0
+# to benchmark the pure-fp32 path
+BF16 = os.environ.get("BENCH_BF16", "1") != "0"
 SAMPLES_PER_CLIENT = 50
 WARMUP, TIMED = 3, 10
 
@@ -59,7 +62,11 @@ def main():
         normalize=make_normalizer(CIFAR10_MEAN, CIFAR10_STD),
     )
 
-    spec = build_fns(cct_2_3x2_32(num_classes=10), sample_shape=(32, 32, 3))
+    spec = build_fns(
+        cct_2_3x2_32(num_classes=10),
+        sample_shape=(32, 32, 3),
+        compute_dtype=jnp.bfloat16 if BF16 else None,
+    )
     params = spec.init(jax.random.PRNGKey(0))
 
     devices = jax.devices()

@@ -26,6 +26,16 @@ from blades_tpu.aggregators.fltrust import Fltrust
 from blades_tpu.aggregators.byzantinesgd import Byzantinesgd
 from blades_tpu.aggregators.dnc import Dnc
 from blades_tpu.aggregators.signguard import Signguard
+from blades_tpu.aggregators.decentralized import (
+    AnchorClipping,
+    Asynccenteredclipping,
+    Asyncmean,
+    DecentralizedMixing,
+    fully_connected_adjacency,
+    metropolis_weights,
+    ring_adjacency,
+    torus_adjacency,
+)
 
 AGGREGATORS: Dict[str, Type[Aggregator]] = {
     "mean": Mean,
@@ -42,6 +52,8 @@ AGGREGATORS: Dict[str, Type[Aggregator]] = {
     "byzantinesgd": Byzantinesgd,
     "dnc": Dnc,
     "signguard": Signguard,
+    "asyncmean": Asyncmean,
+    "asynccenteredclipping": Asynccenteredclipping,
 }
 
 
@@ -83,5 +95,8 @@ __all__ = [
     "Aggregator", "Mean", "Median", "Trimmedmean", "Krum", "Multikrum",
     "Geomed", "Autogm", "Centeredclipping", "Clustering", "Clippedclustering",
     "Fltrust", "Byzantinesgd", "Dnc", "Signguard",
+    "DecentralizedMixing", "AnchorClipping", "Asyncmean",
+    "Asynccenteredclipping", "ring_adjacency", "torus_adjacency",
+    "fully_connected_adjacency", "metropolis_weights",
     "AGGREGATORS", "get_aggregator", "register_aggregator",
 ]

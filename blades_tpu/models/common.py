@@ -45,6 +45,7 @@ def build_fns(
     param_dtype=jnp.float32,
     input_dtype=None,
     pad_id: Optional[int] = None,
+    compute_dtype=None,
 ) -> ModelSpec:
     """Adapt a flax module to the engine's pure-function interface.
 
@@ -54,12 +55,25 @@ def build_fns(
     ``pad_id``: for text models — derive a validity mask ``x != pad_id`` and
     pass it to the module so padded positions never influence attention or
     pooling (the reference's mask plumbing, ``utils/embedder.py:23-28``).
+    ``compute_dtype``: mixed precision — e.g. ``jnp.bfloat16`` runs the
+    forward/backward in bf16 on the MXU while master params, gradients (via
+    the cast's transpose), loss, and the update pipeline stay float32.
     """
     if loss != "crossentropy":
         raise NotImplementedError(f"loss {loss!r} (reference parity: crossentropy only)")
 
     def _kwargs(x):
         return {"mask": x != pad_id} if pad_id is not None else {}
+
+    def _cast(tree):
+        if compute_dtype is None:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating)
+            else a,
+            tree,
+        )
 
     def init(key: jax.Array):
         dummy = jnp.zeros((1,) + tuple(sample_shape), input_dtype or param_dtype)
@@ -68,13 +82,14 @@ def build_fns(
 
     def train_loss_fn(params, x, y, key):
         logits = module.apply(
-            {"params": params}, x, train=True, rngs={"dropout": key}, **_kwargs(x)
+            {"params": _cast(params)}, _cast(x), train=True,
+            rngs={"dropout": key}, **_kwargs(x)
         )
         top1 = jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
         return cross_entropy(logits, y), {"top1": top1}
 
     def eval_logits_fn(params, x):
-        return module.apply({"params": params}, x, train=False, **_kwargs(x))
+        return module.apply({"params": _cast(params)}, _cast(x), train=False, **_kwargs(x))
 
     return ModelSpec(
         module=module,

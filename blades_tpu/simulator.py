@@ -247,6 +247,7 @@ class Simulator:
         profile_dir: Optional[str] = None,
         client_chunks: int = 1,
         remat: bool = False,
+        compute_dtype: Optional[str] = None,
     ) -> List[float]:
         """Run adversarial training; returns per-round wall times (reference
         ``run`` contract, ``simulator.py:364-457``).
@@ -260,9 +261,10 @@ class Simulator:
         ``jax.profiler`` trace of a ~3-round window starting at the first
         post-compile round of this run (round 2, or the resume round).
         ``client_chunks``/``remat``: HBM control for large populations (see
-        RoundEngine).
+        RoundEngine). ``compute_dtype``: ``'bfloat16'`` for mixed-precision
+        forward/backward (master weights stay float32).
         """
-        spec = self._model_spec(model, loss)
+        spec = self._model_spec(model, loss, compute_dtype)
         batch_size = train_batch_size or self._train_bs
 
         key = jax.random.PRNGKey(self.seed)
@@ -355,7 +357,7 @@ class Simulator:
             )
         return round_times
 
-    def _model_spec(self, model, loss) -> ModelSpec:
+    def _model_spec(self, model, loss, compute_dtype=None) -> ModelSpec:
         if isinstance(model, ModelSpec):
             return model
         sample_shape = tuple(self.dataset.train_x.shape[2:])
@@ -375,6 +377,7 @@ class Simulator:
             loss=loss or "crossentropy",
             input_dtype=input_dtype,
             pad_id=getattr(self.dataset, "pad_id", None),
+            compute_dtype=jnp.dtype(compute_dtype) if compute_dtype else None,
         )
 
     # -- logging (stats-file schema parity, simulator.py:309-362) -------------

@@ -1,0 +1,121 @@
+"""Decentralized/gossip + async aggregator tests (reference internals
+``_DecentralizedAggregator``, ``_AnchorClipping``, ``_AsyncMean``,
+``_AsyncCenteredClipping`` — mean.py:42-116, centeredclipping.py:52-137)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.aggregators import (
+    AnchorClipping,
+    Asynccenteredclipping,
+    Asyncmean,
+    DecentralizedMixing,
+    fully_connected_adjacency,
+    get_aggregator,
+    metropolis_weights,
+    ring_adjacency,
+    torus_adjacency,
+)
+
+
+def test_metropolis_weights_doubly_stochastic():
+    for adj in (ring_adjacency(7), torus_adjacency(3, 4), fully_connected_adjacency(5)):
+        w = metropolis_weights(adj)
+        np.testing.assert_allclose(w.sum(axis=0), 1.0, atol=1e-12)
+        np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-12)
+        np.testing.assert_allclose(w, w.T, atol=1e-12)
+        assert (w >= 0).all()
+        # off-graph entries must be zero
+        assert (w[~adj & ~np.eye(len(adj), dtype=bool)] == 0).all()
+
+
+def test_mixing_matches_per_node_loop():
+    """W @ U row i == sum_j W[i,j] u_j (the reference's per-node loop)."""
+    rng = np.random.RandomState(0)
+    w = metropolis_weights(ring_adjacency(6))
+    u = rng.randn(6, 11).astype(np.float32)
+    mixed = DecentralizedMixing(w).mix(jnp.asarray(u))
+    for i in range(6):
+        expect = sum(w[i, j] * u[j] for j in range(6))
+        np.testing.assert_allclose(np.asarray(mixed[i]), expect, rtol=1e-5)
+
+
+def test_gossip_reaches_consensus():
+    """Repeated mixing with a doubly-stochastic W over a connected graph
+    converges every row to the global average."""
+    rng = np.random.RandomState(1)
+    u = rng.randn(8, 5).astype(np.float32)
+    mixer = DecentralizedMixing(metropolis_weights(ring_adjacency(8)))
+    x = jnp.asarray(u)
+    for _ in range(200):
+        x = mixer.mix(x)
+    np.testing.assert_allclose(
+        np.asarray(x), np.tile(u.mean(axis=0), (8, 1)), atol=1e-4
+    )
+
+
+def test_anchor_clipping_limits_outlier_influence():
+    """With anchors at 0 and a huge outlier row, each clipped contribution
+    has norm <= tau, so the mixed result stays bounded."""
+    k, d, tau = 6, 9, 1.0
+    w = metropolis_weights(fully_connected_adjacency(k))
+    agg = AnchorClipping(w, tau=tau)
+    anchors = agg.init_state(k, d)
+    u = np.zeros((k, d), np.float32)
+    u[0] = 1e6  # byzantine blow-up
+    mixed, new_anchors = agg.mix_with_state(jnp.asarray(u), anchors)
+    assert float(jnp.abs(mixed).max()) <= tau + 1e-5
+    # anchors advanced by the mixed result
+    np.testing.assert_allclose(np.asarray(new_anchors), np.asarray(mixed), atol=1e-6)
+
+
+def test_async_mean_denominator_is_total():
+    u = jnp.asarray(np.arange(12, dtype=np.float32).reshape(4, 3))
+    present = jnp.array([True, True, False, False])
+    agg = get_aggregator("asyncmean")
+    out, _ = agg.aggregate(u, (), present=present)
+    np.testing.assert_allclose(np.asarray(out), (u[0] + u[1]) / 4.0)
+    full, _ = agg.aggregate(u, ())
+    np.testing.assert_allclose(np.asarray(full), np.asarray(u.mean(axis=0)))
+
+
+def test_async_centered_clipping_damps_by_total():
+    k, d = 4, 6
+    rng = np.random.RandomState(2)
+    u = rng.randn(k, d).astype(np.float32) * 0.1
+    present = jnp.array([True, False, True, True])
+    agg = get_aggregator("asynccenteredclipping", tau=10.0)
+    state = agg.init_state(k, d)
+    out, state = agg.aggregate(jnp.asarray(u), state, present=present)
+    expect = u[[0, 2, 3]].sum(axis=0) / k  # small updates: no clipping active
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+    # stateful: momentum carried to the next round
+    out2, _ = agg.aggregate(jnp.zeros((k, d)), state, present=present)
+    assert np.abs(np.asarray(out2)).sum() < np.abs(np.asarray(out)).sum() + 1e-6
+
+
+def test_metropolis_rejects_directed_graph():
+    adj = ring_adjacency(5)
+    adj[0, 1] = False  # break symmetry
+    with pytest.raises(ValueError):
+        metropolis_weights(adj)
+
+
+def test_anchor_clipping_matches_naive_pairwise():
+    """Gram-trick mixing == the direct [K,K,D] computation."""
+    rng = np.random.RandomState(3)
+    k, d, tau = 5, 7, 0.7
+    w = metropolis_weights(ring_adjacency(k))
+    u = rng.randn(k, d).astype(np.float32)
+    a = rng.randn(k, d).astype(np.float32) * 0.5
+    agg = AnchorClipping(w, tau=tau)
+    mixed, _ = agg.mix_with_state(jnp.asarray(u), jnp.asarray(a))
+    # naive reference computation
+    expect = np.zeros((k, d), np.float32)
+    for r in range(k):
+        for s in range(k):
+            diff = u[s] - a[r]
+            scl = min(1.0, tau / max(np.linalg.norm(diff), 1e-12))
+            expect[r] += w[r, s] * (a[r] + diff * scl)
+    np.testing.assert_allclose(np.asarray(mixed), expect, rtol=2e-3, atol=2e-4)

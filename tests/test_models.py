@@ -165,3 +165,32 @@ def test_text_seq_len_formula():
         x = jnp.zeros((1, 64, 30))
         out, _ = tok.init_with_output(jax.random.PRNGKey(0), x)
         assert out[0].shape[1] == tok.seq_len(64), (k, s, pd, mp)
+
+
+def test_bf16_mixed_precision_close_to_fp32():
+    """compute_dtype=bfloat16: fp32 master params, bf16 forward/backward;
+    loss and grads must stay finite, fp32-typed, and close to the fp32 path."""
+    from blades_tpu.models import create_model
+
+    f32 = build_fns(create_model("cct_2_3x2_32"), (32, 32, 3))
+    b16 = build_fns(create_model("cct_2_3x2_32"), (32, 32, 3),
+                    compute_dtype=jnp.bfloat16)
+    p = f32.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    y = jnp.array([0, 1, 2, 3])
+
+    def grad_of(spec):
+        (l, _), g = jax.value_and_grad(
+            lambda pp: spec.train_loss_fn(pp, x, y, jax.random.PRNGKey(2)),
+            has_aux=True,
+        )(p)
+        return float(l), g
+
+    l32, g32 = grad_of(f32)
+    l16, g16 = grad_of(b16)
+    assert abs(l32 - l16) / max(abs(l32), 1e-6) < 0.05
+    leaves16 = jax.tree_util.tree_leaves(g16)
+    assert all(l.dtype == jnp.float32 for l in leaves16)
+    n32 = float(jnp.sqrt(sum(jnp.sum(a**2) for a in jax.tree_util.tree_leaves(g32))))
+    n16 = float(jnp.sqrt(sum(jnp.sum(a**2) for a in leaves16)))
+    assert abs(n32 - n16) / max(n32, 1e-6) < 0.15

@@ -127,3 +127,9 @@ def test_text_model_end_to_end(tmp_path):
     assert np.isfinite(ev["Loss"])
     # class-conditional unigrams are separable: must beat chance-ish quickly
     assert ev["top1"] > 0.4
+
+
+def test_bf16_run(tmp_path):
+    sim = _sim(tmp_path, aggregator="mean")
+    sim.run("mlp", global_rounds=2, local_steps=1, train_batch_size=8,
+            validate_interval=2, compute_dtype="bfloat16")
