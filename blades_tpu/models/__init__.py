@@ -27,6 +27,7 @@ from blades_tpu.models.cct import (
     vit_lite_7_4_32,
     CCTNet,
 )
+from blades_tpu.models.import_torch import load_torch_checkpoint, torch_cct_to_flax
 from blades_tpu.models.resnet import ResNet18, ResNet34
 from blades_tpu.models.text import (
     TextCCT,
@@ -102,6 +103,8 @@ __all__ = [
     "ResNet34",
     "WideResNet",
     "wrn_28_10",
+    "load_torch_checkpoint",
+    "torch_cct_to_flax",
     "TextCCT",
     "text_cct_2",
     "text_cct_4",

@@ -45,6 +45,7 @@ class Tokenizer(nn.Module):
     pooling_kernel_size: int = 3
     pooling_stride: int = 2
     pooling_padding: int = 1
+    conv_bias: bool = False  # CCT: False; CVT/ViT patchify: True (tokenizer.py:16,28)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -57,7 +58,7 @@ class Tokenizer(nn.Module):
                 (self.kernel_size, self.kernel_size),
                 strides=(self.stride, self.stride),
                 padding=[(self.padding, self.padding)] * 2,
-                use_bias=False,
+                use_bias=self.conv_bias,
                 kernel_init=_he,
             )(x)
             if self.use_act:
@@ -169,6 +170,7 @@ class CCT(nn.Module):
     attention_dropout: float = 0.1
     stochastic_depth: float = 0.1
     positional_embedding: str = "learnable"  # learnable | sine | none
+    conv_bias: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -190,6 +192,7 @@ class CCT(nn.Module):
             in_planes=64,
             max_pool=self.max_pool,
             use_act=self.use_act,
+            conv_bias=self.conv_bias,
         )
         x = tokenizer(x)
         seq_len = x.shape[1]
@@ -313,6 +316,7 @@ def cvt_7_4_32(num_classes: int = 10, img_size: int = 32, **kw) -> CCT:
         n_conv_layers=1,
         max_pool=False,
         use_act=False,
+        conv_bias=True,
         seq_pool=True,
         **kw,
     )
@@ -334,6 +338,7 @@ def vit_lite_7_4_32(num_classes: int = 10, img_size: int = 32, **kw) -> CCT:
         n_conv_layers=1,
         max_pool=False,
         use_act=False,
+        conv_bias=True,
         seq_pool=False,
         **kw,
     )

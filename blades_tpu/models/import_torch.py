@@ -1,0 +1,169 @@
+"""Torch-checkpoint import for the Compact-Transformer zoo.
+
+The reference ships pretrained CCT weights as torch ``state_dict`` files
+fetched by URL (``src/blades/models/cifar10/cctnets/cct.py:13-30,90-118``
+via ``load_state_dict_from_url``). This module converts such a state_dict —
+loaded from a LOCAL ``.pth`` (this build performs no network downloads) —
+into the flax parameter pytree of :class:`blades_tpu.models.cct.CCT`, so a
+user migrating from the reference keeps their checkpoints.
+
+Layout conversions:
+
+- conv: torch OIHW -> flax HWIO
+- linear: torch ``[out, in]`` -> flax ``[in, out]`` kernels
+- LayerNorm ``weight``/``bias`` -> ``scale``/``bias``
+
+Key-structure mapping (torch name -> flax path):
+
+- ``tokenizer.conv_layers.{i}.0.weight`` -> ``Tokenizer_0/Conv_{i}/kernel``
+- ``classifier.positional_emb``/``class_emb`` -> top-level params
+- ``classifier.blocks.{i}.pre_norm`` -> ``TransformerEncoderLayer_{i}/LayerNorm_0``
+- ``classifier.blocks.{i}.self_attn.qkv|proj`` -> ``.../Attention_0/Dense_0|1``
+- ``classifier.blocks.{i}.norm1`` -> ``.../LayerNorm_1``
+- ``classifier.blocks.{i}.linear1|linear2`` -> ``.../Dense_0|1``
+- ``classifier.norm`` -> top-level ``LayerNorm_0``
+- ``classifier.attention_pool`` -> first top-level Dense (seq-pool models)
+- ``classifier.fc`` -> last top-level Dense
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    # accepts torch tensors or arrays without importing torch here
+    return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+
+def torch_cct_to_flax(
+    state_dict: Mapping[str, Any], params_template: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Convert a reference-CCT torch state_dict into our flax param tree.
+
+    ``params_template``: a freshly initialized param tree of the matching
+    variant (supplies structure; every leaf must be covered by the
+    state_dict and vice versa, or a ``ValueError`` explains the mismatch).
+    """
+    import jax
+
+    has_pool = any(k.startswith("classifier.attention_pool") for k in state_dict)
+    out: Dict[str, Any] = jax.tree_util.tree_map(lambda x: None, params_template)
+
+    def put(path, value):
+        node = out
+        for p in path[:-1]:
+            if not isinstance(node, dict) or p not in node:
+                raise ValueError(
+                    f"flax param path {path} missing in template — checkpoint "
+                    "is for a different model variant (depth/width/pooling)?"
+                )
+            node = node[p]
+        if not isinstance(node, dict) or path[-1] not in node:
+            raise ValueError(
+                f"flax param path {path} missing in template — checkpoint "
+                "is for a different model variant (depth/width/pooling)?"
+            )
+        node[path[-1]] = value
+
+    for key, t in state_dict.items():
+        v = _np(t).astype(np.float32)
+        parts = key.split(".")
+        if len(parts) < 2:
+            raise ValueError(
+                f"unrecognized state_dict key {key!r} (not a CCT-zoo "
+                "state_dict? unwrap the checkpoint's 'state_dict' entry)"
+            )
+        if key == "classifier.positional_emb" and "positional_emb" not in out:
+            # *_sine reference variants store the fixed sinusoidal table as a
+            # parameter (utils/transformers.py:277-280); our sine models
+            # compute it, so the key is informational only
+            continue
+        if parts[0] == "tokenizer":
+            # tokenizer.conv_layers.{i}.0.{weight,bias}; weight OIHW -> HWIO
+            i = int(parts[2])
+            if parts[-1] == "weight":
+                put(("Tokenizer_0", f"Conv_{i}", "kernel"), v.transpose(2, 3, 1, 0))
+            else:
+                put(("Tokenizer_0", f"Conv_{i}", "bias"), v)
+        elif key == "classifier.positional_emb":
+            put(("positional_emb",), v)
+        elif key == "classifier.class_emb":
+            put(("class_emb",), v)
+        elif parts[1] == "blocks":
+            i, sub = int(parts[2]), parts[3]
+            layer = f"TransformerEncoderLayer_{i}"
+            kind = "scale" if parts[-1] == "weight" else "bias"
+            if sub == "pre_norm":
+                put((layer, "LayerNorm_0", kind), v)
+            elif sub == "norm1":
+                put((layer, "LayerNorm_1", kind), v)
+            elif sub == "self_attn":
+                which = "Dense_0" if parts[4] == "qkv" else "Dense_1"
+                if parts[-1] == "weight":
+                    put((layer, "Attention_0", which, "kernel"), v.T)
+                else:
+                    put((layer, "Attention_0", which, "bias"), v)
+            elif sub in ("linear1", "linear2"):
+                which = "Dense_0" if sub == "linear1" else "Dense_1"
+                if parts[-1] == "weight":
+                    put((layer, which, "kernel"), v.T)
+                else:
+                    put((layer, which, "bias"), v)
+            else:
+                raise ValueError(f"unrecognized block entry {key!r}")
+        elif parts[1] == "norm":
+            put(("LayerNorm_0", "scale" if parts[-1] == "weight" else "bias"), v)
+        elif parts[1] == "attention_pool":
+            tgt = ("Dense_0", parts[-1].replace("weight", "kernel"))
+            put(tgt, v.T if parts[-1] == "weight" else v)
+        elif parts[1] == "fc":
+            name = "Dense_1" if has_pool else "Dense_0"
+            put(
+                (name, parts[-1].replace("weight", "kernel")),
+                v.T if parts[-1] == "weight" else v,
+            )
+        else:
+            raise ValueError(f"unrecognized state_dict key {key!r}")
+
+    # completeness + shape validation against the template
+    import jax.numpy as jnp
+
+    def check(path, tmpl_leaf, new_leaf):
+        if new_leaf is None:
+            raise ValueError(f"state_dict left flax param {path} unfilled")
+        if tuple(tmpl_leaf.shape) != tuple(new_leaf.shape):
+            raise ValueError(
+                f"shape mismatch at {path}: checkpoint {new_leaf.shape} vs "
+                f"model {tmpl_leaf.shape}"
+            )
+        return jnp.asarray(new_leaf)
+
+    flat_t, treedef = jax.tree_util.tree_flatten_with_path(params_template)
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [
+            check(jax.tree_util.keystr(p), leaf, _leaf_at(out, p))
+            for p, leaf in flat_t
+        ],
+    )
+
+
+def _leaf_at(tree, path):
+    node = tree
+    for p in path:
+        node = node[getattr(p, "key", p)]
+    return node
+
+
+def load_torch_checkpoint(path: str, params_template: Dict[str, Any]):
+    """Load a reference ``.pth`` checkpoint file and convert (requires the
+    baked-in CPU torch only for deserialization)."""
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(sd, dict) and "state_dict" in sd:
+        sd = sd["state_dict"]
+    return torch_cct_to_flax(sd, params_template)
