@@ -3,8 +3,9 @@
 Reference: ``Trimmedmean`` (``src/blades/aggregators/trimmedmean.py:9-45``):
 drop the largest and smallest ``b`` values per coordinate via two ``topk``
 calls, average the rest; ``b`` auto-shrinks when ``K - 2b <= 0``
-(``trimmedmean.py:29-36``). Here it is one sort along the client axis plus a
-static slice — K is a trace-time constant, so XLA sees a fixed-shape sort.
+(``trimmedmean.py:29-36``). On TPU the selection runs as a one-HBM-pass
+pallas kernel (``ops/pallas_trimmed.py``); elsewhere it is one sort along
+the client axis plus a static slice.
 """
 
 from __future__ import annotations
@@ -12,6 +13,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.ops.pallas_trimmed import trimmed_mean
 
 
 class Trimmedmean(Aggregator):
@@ -26,10 +28,7 @@ class Trimmedmean(Aggregator):
             b -= 1
         if b < 0:
             raise RuntimeError(f"cannot trim {self.b} from {k} clients")
-        if b == 0:
-            return jnp.mean(updates, axis=0), state
-        s = jnp.sort(updates, axis=0)
-        return jnp.mean(s[b : k - b], axis=0), state
+        return trimmed_mean(updates, b), state
 
     def __repr__(self):
         return f"Trimmed Mean (b={self.b})"

@@ -183,6 +183,7 @@ class RoundEngine:
         self._server_tx = server_opt.transform()
         self._round_jit = jax.jit(self._round, donate_argnums=(0,))
         self._eval_jit = jax.jit(self._eval_batch)
+        self._eval_per_sample_jit = jax.jit(self._eval_batch_per_sample)
 
     # -- state ---------------------------------------------------------------
 
@@ -404,12 +405,16 @@ class RoundEngine:
 
     # -- evaluation ----------------------------------------------------------
 
-    def _eval_batch(self, params, x, y, mask):
+    def _eval_batch_per_sample(self, params, x, y):
         logits = self.eval_logits_fn(params, x)
         one_hot = jax.nn.one_hot(y, logits.shape[-1])
         logp = jax.nn.log_softmax(logits.astype(jnp.float32))
         losses = -(one_hot * logp).sum(axis=-1)
         correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
+        return losses, correct
+
+    def _eval_batch(self, params, x, y, mask):
+        losses, correct = self._eval_batch_per_sample(params, x, y)
         m = mask.astype(jnp.float32)
         return (losses * m).sum(), (correct * m).sum(), m.sum()
 
@@ -439,6 +444,27 @@ class RoundEngine:
             tot_correct += float(c)
             tot_n += float(m)
         return {"Loss": tot_loss / tot_n, "top1": tot_correct / tot_n}
+
+    def evaluate_per_sample(
+        self, state: RoundState, x: jnp.ndarray, y: jnp.ndarray, batch_size: int = 512
+    ):
+        """Per-sample test loss and correctness (numpy [N] arrays) — the
+        building block for per-client validation records."""
+        import numpy as np
+
+        n = x.shape[0]
+        losses, correct = [], []
+        for beg in range(0, n, batch_size):
+            xb = x[beg : beg + batch_size]
+            yb = y[beg : beg + batch_size]
+            pad = batch_size - xb.shape[0]
+            if pad:
+                xb = jnp.pad(xb, [(0, pad)] + [(0, 0)] * (xb.ndim - 1))
+                yb = jnp.pad(yb, [(0, pad)])
+            l, c = self._eval_per_sample_jit(state.params, xb, yb)
+            losses.append(np.asarray(l)[: batch_size - pad if pad else batch_size])
+            correct.append(np.asarray(c)[: batch_size - pad if pad else batch_size])
+        return np.concatenate(losses), np.concatenate(correct)
 
 
 def multistep_lr(lr0: float, milestones=(), gamma: float = 0.5) -> Callable[[int], float]:

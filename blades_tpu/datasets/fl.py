@@ -126,6 +126,37 @@ class FLDataset:
             self._sample_jit[sig] = self._build_sampler(local_steps, batch_size)
         return self._sample_jit[sig](key)
 
+    def get_train_data(
+        self, u_id: int, num_batches: int, batch_size: int = 32,
+        key: Optional[jax.Array] = None,
+    ) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
+        """Reference-API parity (``FLDataset.get_train_data``,
+        ``src/blades/datasets/dataset.py:110-112``): pull ``num_batches``
+        batches for one client. The reference draws from a per-client
+        infinite generator; here batches are sampled by key from the
+        client's device-resident rows."""
+        i = self.client_ids.index(u_id)
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        n = self.train_counts[i]
+        idx = jax.random.randint(
+            key, (num_batches * batch_size,), 0, jnp.maximum(n, 1)
+        )
+        x = self.train_x[i][idx]
+        if self.normalize is not None:
+            x = self.normalize(x)
+        y = self.train_y[i][idx]
+        xs = x.reshape((num_batches, batch_size) + x.shape[1:])
+        ys = y.reshape(num_batches, batch_size)
+        return [(xs[b], ys[b]) for b in range(num_batches)]
+
+    def get_all_test_data(self, u_id: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Reference-API parity (``dataset.py:114-115``). Deviation: the test
+        set is kept as one union array (per-client test shards would only be
+        re-averaged by data size, which equals union metrics — see
+        ``RoundEngine.evaluate``), so every ``u_id`` sees the same data."""
+        return self.test_x, self.test_y
+
     # -- construction from per-client lists -----------------------------------
 
     @staticmethod

@@ -405,18 +405,40 @@ class Simulator:
         self.json_logger.info(r)
 
     def evaluate(self, rnd: int, batch_size: int = 64) -> Dict:
-        ev = self.engine.evaluate(
+        """Reference test flow (``test_actor`` -> ``log_validate``,
+        ``simulator.py:282-307,324-335``): every client evaluates the global
+        model on its own test shard (one ``client_validation`` record each,
+        ``client.py:144-176``), then the data-size-weighted average is logged
+        as the ``test`` record. One batched forward pass computes all of it;
+        test shards are the even split of the union test set (the
+        reference's ``np.split``, ``datasets/cifar10.py:67-68``)."""
+        losses, correct = self.engine.evaluate_per_sample(
             self.server.state,
             self.dataset.test_x,
             self.dataset.test_y,
             batch_size=batch_size,
         )
+        n = losses.shape[0]
+        shards = np.array_split(np.arange(n), self.dataset.num_clients)
+        for u, idx in zip(self._clients, shards):
+            if len(idx) == 0:
+                continue
+            r = {
+                "_meta": {"type": "client_validation"},
+                "E": rnd,
+                "id": u,
+                "Length": int(len(idx)),
+                "Loss": float(losses[idx].mean()),
+                "top1": float(correct[idx].mean()),
+            }
+            self.json_logger.info(r)
+        ev = {"Loss": float(losses.mean()), "top1": float(correct.mean())}
         r = {
             "_meta": {"type": "test"},
             "Round": rnd,
-            "top1": float(ev["top1"]),
-            "Length": int(self.dataset.test_y.shape[0]),
-            "Loss": float(ev["Loss"]),
+            "top1": ev["top1"],
+            "Length": n,
+            "Loss": ev["Loss"],
         }
         self.json_logger.info(r)
         return ev

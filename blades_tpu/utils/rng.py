@@ -34,6 +34,16 @@ CLIENTS = 5
 AGG = 6
 
 
+def set_random_seed(seed: int = 0) -> jax.Array:
+    """Reference-API parity (``set_random_seed``, ``src/blades/utils.py:116-124``):
+    seed the host-side numpy RNG (used by partitioners) and return the JAX
+    root key that seeds every device-side stream."""
+    import numpy as np
+
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
 def key_for_round(seed_key: jax.Array, round_idx) -> jax.Array:
     return jax.random.fold_in(seed_key, round_idx)
 

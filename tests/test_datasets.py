@@ -119,3 +119,32 @@ def test_hflip_is_flip():
     img = jnp.arange(12.0).reshape(2, 2, 3)
     flipped = random_hflip(jax.random.PRNGKey(0), img, p=1.0)
     np.testing.assert_array_equal(flipped, img[:, ::-1, :])
+
+
+def test_get_train_data_parity_api():
+    from blades_tpu.datasets import Synthetic
+
+    fl = Synthetic(num_clients=4, train_size=200, test_size=40, cache=False).get_dls()
+    batches = fl.get_train_data(fl.get_clients()[1], num_batches=3, batch_size=8)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape[0] == 8 and y.shape == (8,)
+    tx, ty = fl.get_all_test_data(0)
+    assert tx.shape[0] == ty.shape[0] == 40
+
+
+def test_set_random_seed_returns_key():
+    from blades_tpu.utils.rng import set_random_seed
+    import numpy as np
+
+    k = set_random_seed(7)
+    a = np.random.rand(3)
+    set_random_seed(7)
+    b = np.random.rand(3)
+    np.testing.assert_array_equal(a, b)
+    import jax
+    import jax.numpy as jnp
+
+    # a valid PRNG key: either new-style typed key or legacy uint32[2]
+    is_typed = jnp.issubdtype(k.dtype, jax.dtypes.prng_key)
+    assert is_typed or (k.shape == (2,) and k.dtype == jnp.uint32)

@@ -51,9 +51,18 @@ def test_run_writes_stats_log(tmp_path):
     lines = open(os.path.join(sim.json_logger.handlers[0].baseFilename)).readlines()
     recs = [ast.literal_eval(l) for l in lines]
     types = {r["_meta"]["type"] for r in recs}
-    assert types == {"train", "variance", "test"}
+    assert types == {"train", "variance", "test", "client_validation"}
     test_recs = [r for r in recs if r["_meta"]["type"] == "test"]
     assert {"Round", "top1", "Length", "Loss"} <= set(test_recs[0])
+    cv = [r for r in recs if r["_meta"]["type"] == "client_validation"]
+    # one record per client per validation round (reference client.py:147-152)
+    assert len(cv) % 6 == 0 and {"E", "Length", "Loss", "top1"} <= set(cv[0])
+    # the test record is the Length-weighted average of the client records
+    last_round_cv = [r for r in cv if r["E"] == test_recs[-1]["Round"]]
+    w = sum(r["Length"] for r in last_round_cv)
+    avg = sum(r["top1"] * r["Length"] for r in last_round_cv) / w
+    assert abs(avg - test_recs[-1]["top1"]) < 1e-6  # f32 shard-mean roundoff
+    assert len({r["id"] for r in last_round_cv}) == len(last_round_cv)
 
 
 def test_learning_happens(tmp_path):
