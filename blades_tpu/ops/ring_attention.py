@@ -1,0 +1,115 @@
+"""Ring attention: sequence-parallel exact attention over the device mesh.
+
+The reference has no long-context machinery at all — its attention runs over
+<=256 tokens on one device (``cctnets/utils/transformers.py:8-37``; SURVEY.md
+section 5 "long-context: absent by design"). This module makes long sequences
+first-class on TPU: the sequence axis is sharded across a mesh axis, every
+device keeps its Q block resident, and K/V blocks rotate around the ring via
+``lax.ppermute`` (neighbor hops over ICI) while an online-softmax accumulator
+(running max ``m``, normalizer ``l``, output ``o`` — the flash-attention
+recurrence) folds in one block per step. Exact attention, O(N/P) activation
+memory per device, compute/communication overlapped by XLA.
+
+Layout: ``[B, N, H, Dh]`` with N sharded. The optional ``kv_mask``
+(``[B, N]`` bool, True = valid token) rides the ring with its K/V block, so
+padded positions are excluded exactly as in single-device masked attention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_update(q, k, v, kv_mask, m, l, o, scale):
+    """Fold one K/V block into the online-softmax accumulator."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, Nq, Nk]
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, NEG_INF)
+    m_cur = jnp.max(s, axis=-1)  # [B, H, Nq]
+    m_new = jnp.maximum(m, m_cur)
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)  # rescale of the old accumulator
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def _ring_body(q, k, v, kv_mask, axis_name: str, scale: float):
+    """Per-device program: rotate K/V (and mask) around the ring."""
+    n_dev = lax.psum(1, axis_name)
+    b, nq, h, d = q.shape
+    m = jnp.full((b, h, nq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, nq), jnp.float32)
+    o = jnp.zeros((b, h, nq, d), jnp.float32)
+
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def step(_, carry):
+        k_blk, v_blk, mask_blk, m, l, o = carry
+        m, l, o = _block_update(q, k_blk, v_blk, mask_blk, m, l, o, scale)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        if mask_blk is not None:
+            mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+        return k_blk, v_blk, mask_blk, m, l, o
+
+    _, _, _, m, l, o = lax.fori_loop(0, n_dev, step, (k, v, kv_mask, m, l, o))
+    # [B, H, Nq, D] -> [B, Nq, H, D]; guard fully-masked rows (l == 0)
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str,
+    kv_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Exact multi-head attention with the sequence axis sharded over
+    ``mesh[axis_name]``.
+
+    ``q``/``k``/``v``: ``[B, N, H, Dh]`` (N divisible by the axis size);
+    ``kv_mask``: optional ``[B, N]`` bool validity mask. Returns ``[B, N, H,
+    Dh]`` sharded like ``q``.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    scale = q.shape[-1] ** -0.5
+    seq = P(None, axis_name, None, None)
+    mask_spec = P(None, axis_name)
+    in_specs = (seq, seq, seq) + ((mask_spec,) if kv_mask is not None else ())
+    fn = functools.partial(_ring_body, axis_name=axis_name, scale=scale)
+
+    if kv_mask is not None:
+        body = lambda q_, k_, v_, mk: fn(q_, k_, v_, mk)
+        args = (q, k, v, kv_mask)
+    else:
+        body = lambda q_, k_, v_: fn(q_, k_, v_, None)
+        args = (q, k, v)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=seq, check_rep=False
+    )(*args)
+
+
+def attention_reference(q, k, v, kv_mask=None):
+    """Single-device full-softmax attention (testing oracle)."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -1,0 +1,78 @@
+"""Ring attention vs full-softmax oracle on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from blades_tpu.ops.ring_attention import attention_reference, ring_attention
+
+SEQ = "seq"
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()), (SEQ,))
+
+
+def _qkv(key, b=2, n=64, h=4, d=16):
+    ks = jax.random.split(key, 3)
+    shape = (b, n, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+def test_matches_full_attention():
+    mesh = _mesh()
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    out = ring_attention(q, k, v, mesh, SEQ)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_matches_full_attention_with_mask():
+    mesh = _mesh()
+    q, k, v = _qkv(jax.random.PRNGKey(1), b=3, n=32)
+    lens = jnp.array([[5], [32], [17]])
+    mask = jnp.arange(32)[None, :] < lens
+    out = ring_attention(q, k, v, mesh, SEQ, kv_mask=mask)
+    ref = attention_reference(q, k, v, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_sharded_inputs_stay_sharded():
+    mesh = _mesh()
+    q, k, v = _qkv(jax.random.PRNGKey(2), n=128)
+    spec = NamedSharding(mesh, P(None, SEQ, None, None))
+    q, k, v = (jax.device_put(t, spec) for t in (q, k, v))
+    out = jax.jit(
+        lambda a, b_, c: ring_attention(a, b_, c, mesh, SEQ)
+    )(q, k, v)
+    assert out.sharding.spec == spec.spec
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_gradients_flow():
+    mesh = _mesh()
+    q, k, v = _qkv(jax.random.PRNGKey(3), n=16)
+
+    def loss_ring(q_, k_, v_):
+        return jnp.sum(ring_attention(q_, k_, v_, mesh, SEQ) ** 2)
+
+    def loss_ref(q_, k_, v_):
+        return jnp.sum(attention_reference(q_, k_, v_) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_long_sequence_memory_shape():
+    """N=1024 over 8 devices: each device sees N/8 of Q and one rotating
+    K/V block — the whole [N, N] score matrix never materializes."""
+    mesh = _mesh()
+    q, k, v = _qkv(jax.random.PRNGKey(4), b=1, n=1024, h=2, d=8)
+    out = ring_attention(q, k, v, mesh, SEQ)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
