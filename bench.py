@@ -10,119 +10,349 @@ Baseline: BASELINE_PROXY.json, a measured torch-CPU serial proxy of the
 reference's round loop (see scripts/measure_baseline_proxy.py — the real
 reference needs Ray, absent here). Prints ONE json line:
   {"metric": ..., "value": N, "unit": "rounds/sec", "vs_baseline": N}
+
+Robustness contract (the driver must never see an empty stdout): the
+parent process ladders through attempt configs — the full K=1000 run,
+then a reduced-K smoke fallback — each in a fresh subprocess with a
+timeout and one retry (TPU backend "Unavailable" errors are transient and
+poison the owning process). Whatever happens, exactly one JSON line is
+emitted; on total failure it carries ``"value": null`` and an ``"error"``
+field naming the failing stage.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-K = int(os.environ.get("BENCH_CLIENTS", 1000))
-LOCAL_STEPS = int(os.environ.get("BENCH_LOCAL_STEPS", 1))
-BATCH = int(os.environ.get("BENCH_BATCH", 32))
-# sequential client chunks bound activation HBM (see RoundEngine docstring);
-# 10 chunks of 100 clients still push 3200 images per conv batch to the MXU
-CHUNKS = int(os.environ.get("BENCH_CHUNKS", 10))
-# bf16 forward/backward on the MXU (master weights fp32); set BENCH_BF16=0
-# to benchmark the pure-fp32 path
-BF16 = os.environ.get("BENCH_BF16", "1") != "0"
+METRIC = "cifar10_fedsgd_trimmedmean_1000c_rounds_per_sec"
 SAMPLES_PER_CLIENT = 50
 WARMUP, TIMED = 3, 10
 
 
-def main():
-    from blades_tpu.aggregators import get_aggregator
-    from blades_tpu.core import ClientOptSpec, RoundEngine, ServerOptSpec
-    from blades_tpu.datasets.fl import FLDataset
-    from blades_tpu.models import cct_2_3x2_32
-    from blades_tpu.models.common import build_fns
-    from blades_tpu.parallel.mesh import make_mesh, make_plan
+# --------------------------------------------------------------------------
+# children: backend probe + one measurement attempt (own process each)
+# --------------------------------------------------------------------------
 
-    rng = np.random.RandomState(0)
-    train_x = rng.randint(0, 256, (K, SAMPLES_PER_CLIENT, 32, 32, 3), dtype=np.uint8)
-    train_y = rng.randint(0, 10, (K, SAMPLES_PER_CLIENT)).astype(np.int32)
-    counts = np.full(K, SAMPLES_PER_CLIENT, np.int32)
-    from blades_tpu.datasets.augment import make_normalizer
-    from blades_tpu.datasets.cifar10 import CIFAR10_MEAN, CIFAR10_STD
+def probe_main() -> None:
+    """Cheap backend liveness check: import jax, init backend, jit x+1."""
+    try:
+        _maybe_force_cpu()
+        import jax
+        import jax.numpy as jnp
 
-    ds = FLDataset(
-        train_x,
-        train_y,
-        counts,
-        train_x[0],
-        train_y[0],
-        normalize=make_normalizer(CIFAR10_MEAN, CIFAR10_STD),
+        jax.jit(lambda x: x + 1)(jnp.zeros(8)).block_until_ready()
+        print(
+            "BENCH_CHILD_RESULT "
+            + json.dumps(
+                {
+                    "probe": "ok",
+                    "platform": jax.devices()[0].platform,
+                    "n_devices": len(jax.devices()),
+                }
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001
+        print(
+            "BENCH_CHILD_RESULT "
+            + json.dumps({"error": f"probe: {type(e).__name__}: {e}"[:500]}),
+            flush=True,
+        )
+        sys.exit(1)
+
+
+def _maybe_force_cpu() -> None:
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        import jax  # noqa: F401  (import before config update)
+
+        from blades_tpu.utils.platform import force_virtual_cpu
+
+        force_virtual_cpu(int(os.environ.get("BENCH_CPU_DEVICES", 8)))
+
+
+def child_main() -> None:
+    k = int(os.environ.get("BENCH_CLIENTS", 1000))
+    local_steps = int(os.environ.get("BENCH_LOCAL_STEPS", 1))
+    batch = int(os.environ.get("BENCH_BATCH", 32))
+    # sequential client chunks bound activation HBM (see RoundEngine
+    # docstring); 10 chunks of 100 clients still push 3200 images per conv
+    # batch to the MXU
+    chunks = int(os.environ.get("BENCH_CHUNKS", 10))
+    # bf16 forward/backward on the MXU (master weights fp32); set
+    # BENCH_BF16=0 to benchmark the pure-fp32 path
+    bf16 = os.environ.get("BENCH_BF16", "1") != "0"
+    warmup = int(os.environ.get("BENCH_WARMUP", WARMUP))
+    timed = int(os.environ.get("BENCH_TIMED", TIMED))
+
+    stage = "import"
+    try:
+        _maybe_force_cpu()
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from blades_tpu.utils.xla_cache import enable_compilation_cache
+
+        enable_compilation_cache()
+
+        # pre-flight: a trivial jit proves the backend is up before we pay
+        # for the big compile; retry because backend setup errors are
+        # transient (r01 failed here, r02 failed one compile later)
+        stage = "preflight"
+        for attempt in range(3):
+            try:
+                jax.jit(lambda x: x + 1)(jnp.zeros(8)).block_until_ready()
+                break
+            except Exception:
+                if attempt == 2:
+                    raise
+                time.sleep(5)
+
+        stage = "build"
+        from blades_tpu.aggregators import get_aggregator
+        from blades_tpu.core import ClientOptSpec, RoundEngine, ServerOptSpec
+        from blades_tpu.datasets.augment import make_normalizer
+        from blades_tpu.datasets.cifar10 import CIFAR10_MEAN, CIFAR10_STD
+        from blades_tpu.datasets.fl import FLDataset
+        from blades_tpu.models import cct_2_3x2_32
+        from blades_tpu.models.common import build_fns
+        from blades_tpu.parallel.mesh import make_mesh, make_plan
+
+        rng = np.random.RandomState(0)
+        train_x = rng.randint(
+            0, 256, (k, SAMPLES_PER_CLIENT, 32, 32, 3), dtype=np.uint8
+        )
+        train_y = rng.randint(0, 10, (k, SAMPLES_PER_CLIENT)).astype(np.int32)
+        counts = np.full(k, SAMPLES_PER_CLIENT, np.int32)
+        ds = FLDataset(
+            train_x,
+            train_y,
+            counts,
+            train_x[0],
+            train_y[0],
+            normalize=make_normalizer(CIFAR10_MEAN, CIFAR10_STD),
+        )
+
+        spec = build_fns(
+            cct_2_3x2_32(num_classes=10),
+            sample_shape=(32, 32, 3),
+            compute_dtype=jnp.bfloat16 if bf16 else None,
+        )
+        params = spec.init(jax.random.PRNGKey(0))
+
+        devices = jax.devices()
+        plan = make_plan(make_mesh(devices)) if len(devices) > 1 else None
+        engine = RoundEngine(
+            spec.train_loss_fn,
+            spec.eval_logits_fn,
+            params,
+            num_clients=k,
+            num_byzantine=0,
+            aggregator=get_aggregator("trimmedmean"),
+            client_opt=ClientOptSpec(),
+            server_opt=ServerOptSpec(),
+            num_classes=10,
+            plan=plan,
+            client_chunks=chunks,
+            remat=True,
+        )
+        state = engine.init(params)
+        key = jax.random.PRNGKey(7)
+
+        # materialize the sampler alone first: separates a flaky-backend
+        # compile error from a round-program one in the reported stage
+        stage = "sampler"
+        cx, cy = ds.sample_round(jax.random.fold_in(key, 0), local_steps, batch)
+        jax.block_until_ready(cy)
+
+        def one_round(state, r):
+            cx, cy = ds.sample_round(
+                jax.random.fold_in(key, r), local_steps, batch
+            )
+            state, m = engine.run_round(state, cx, cy, 0.1, 1.0, key)
+            return state, m
+
+        stage = "warmup"
+        for r in range(warmup):
+            state, m = one_round(state, r)
+        jax.block_until_ready(state.params)
+
+        stage = "timed"
+        t0 = time.time()
+        for r in range(warmup, warmup + timed):
+            state, m = one_round(state, r)
+        jax.block_until_ready(state.params)
+        elapsed = time.time() - t0
+
+        loss = float(m.train_loss)
+        if not np.isfinite(loss):
+            raise RuntimeError(f"non-finite loss {loss}")
+        print(
+            "BENCH_CHILD_RESULT "
+            + json.dumps(
+                {
+                    "rounds_per_sec": timed / elapsed,
+                    "clients": k,
+                    "train_loss": loss,
+                    "platform": devices[0].platform,
+                    "n_devices": len(devices),
+                }
+            ),
+            flush=True,
+        )
+    except Exception as e:  # noqa: BLE001 - report and let the parent ladder
+        print(
+            "BENCH_CHILD_RESULT "
+            + json.dumps(
+                {"error": f"{stage}: {type(e).__name__}: {e}"[:500], "clients": k}
+            ),
+            flush=True,
+        )
+        sys.exit(1)
+
+
+# --------------------------------------------------------------------------
+# parent: attempt ladder, single JSON line out
+# --------------------------------------------------------------------------
+
+def _run_child(env_overrides: dict, timeout_s: float):
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    env.update({k: str(v) for k, v in env_overrides.items()})
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_s:.0f}s"
+    result = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("BENCH_CHILD_RESULT "):
+            result = json.loads(line[len("BENCH_CHILD_RESULT "):])
+    if result is None:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-5:]
+        return None, f"rc={proc.returncode}, no result line; tail: {' | '.join(tail)}"
+    if "error" in result:
+        return None, result["error"]
+    return result, None
+
+
+def main() -> None:
+    full_k = int(os.environ.get("BENCH_CLIENTS", 1000))
+    full_timeout = float(os.environ.get("BENCH_TIMEOUT", 1500))
+    smoke_k = int(os.environ.get("BENCH_SMOKE_CLIENTS", 100))
+    smoke_timeout = float(os.environ.get("BENCH_SMOKE_TIMEOUT", 600))
+    chunks = os.environ.get("BENCH_CHUNKS", 10)
+
+    errors = []
+    # liveness probe first: when the TPU tunnel is down, backend init hangs
+    # forever — better to burn 240s learning that than the full ladder
+    probe, probe_err = _run_child(
+        {"BENCH_PROBE": 1},
+        float(os.environ.get("BENCH_PROBE_TIMEOUT", 240)),
     )
-
-    spec = build_fns(
-        cct_2_3x2_32(num_classes=10),
-        sample_shape=(32, 32, 3),
-        compute_dtype=jnp.bfloat16 if BF16 else None,
+    on_accelerator = probe is not None and probe.get("platform") not in (
+        None, "cpu"
     )
-    params = spec.init(jax.random.PRNGKey(0))
+    if not on_accelerator:
+        if probe is None:
+            errors.append(f"probe: {probe_err}")
+        else:
+            errors.append(
+                f"probe: default platform is {probe.get('platform')!r}, "
+                "not an accelerator"
+            )
+        # no reachable accelerator — fall back to a virtual CPU mesh so the
+        # harness still proves the round program end to end; clearly
+        # labeled, never comparable to the TPU headline
+        # measured: K=8 fp32 CCT is ~2.5 min end to end on the 8-device
+        # virtual CPU mesh (compile-dominated); larger K or bf16 blows the
+        # timeout without proving anything more
+        ladder = [
+            (
+                {"BENCH_CLIENTS": 8, "BENCH_CHUNKS": 1, "BENCH_BATCH": 8,
+                 "BENCH_BF16": 0, "BENCH_FORCE_CPU": 1,
+                 "BENCH_WARMUP": 1, "BENCH_TIMED": 2},
+                smoke_timeout,
+                "cpu-smoke",
+            ),
+        ]
+    else:
+        ladder = [
+            ({"BENCH_CLIENTS": full_k, "BENCH_CHUNKS": chunks},
+             full_timeout, "full"),
+            ({"BENCH_CLIENTS": full_k, "BENCH_CHUNKS": chunks},
+             full_timeout, "full-retry"),
+            ({"BENCH_CLIENTS": smoke_k, "BENCH_CHUNKS": 2},
+             smoke_timeout, "smoke"),
+        ]
 
-    devices = jax.devices()
-    plan = make_plan(make_mesh(devices)) if len(devices) > 1 else None
-    engine = RoundEngine(
-        spec.train_loss_fn,
-        spec.eval_logits_fn,
-        params,
-        num_clients=K,
-        num_byzantine=0,
-        aggregator=get_aggregator("trimmedmean"),
-        client_opt=ClientOptSpec(),
-        server_opt=ServerOptSpec(),
-        num_classes=10,
-        plan=plan,
-        client_chunks=CHUNKS,
-        remat=True,
-    )
-    state = engine.init(params)
-    key = jax.random.PRNGKey(7)
-
-    def one_round(state, r):
-        cx, cy = ds.sample_round(jax.random.fold_in(key, r), LOCAL_STEPS, BATCH)
-        state, m = engine.run_round(state, cx, cy, 0.1, 1.0, key)
-        return state, m
-
-    for r in range(WARMUP):
-        state, m = one_round(state, r)
-    jax.block_until_ready(state.params)
-
-    t0 = time.time()
-    for r in range(WARMUP, WARMUP + TIMED):
-        state, m = one_round(state, r)
-    jax.block_until_ready(state.params)
-    elapsed = time.time() - t0
-
-    rounds_per_sec = TIMED / elapsed
-    assert np.isfinite(float(m.train_loss)), "non-finite loss"
+    result = None
+    queue = list(ladder)
+    while queue:
+        overrides, timeout_s, name = queue.pop(0)
+        result, err = _run_child(overrides, timeout_s)
+        if result is not None:
+            break
+        errors.append(f"{name}: {err}")
+        if err and err.startswith("timeout") and name == "full":
+            # a full-config timeout is almost certainly not transient;
+            # skip the identical retry and drop straight to smoke
+            errors.append("full-retry: skipped after timeout")
+            queue = [q for q in queue if q[2] != "full-retry"]
 
     baseline_path = os.path.join(os.path.dirname(__file__), "BASELINE_PROXY.json")
-    vs = None
+    baseline_rps = None
     if os.path.exists(baseline_path):
         with open(baseline_path) as f:
-            vs = rounds_per_sec / json.load(f)["rounds_per_sec"]
+            baseline_rps = json.load(f)["rounds_per_sec"]
 
-    print(
-        json.dumps(
-            {
-                "metric": "cifar10_fedsgd_trimmedmean_1000c_rounds_per_sec",
-                "value": round(rounds_per_sec, 4),
-                "unit": "rounds/sec",
-                "vs_baseline": round(vs, 2) if vs is not None else None,
-            }
+    if result is None:
+        print(
+            json.dumps(
+                {
+                    "metric": METRIC,
+                    "value": None,
+                    "unit": "rounds/sec",
+                    "vs_baseline": None,
+                    "error": "; ".join(errors)[:1000],
+                }
+            )
         )
-    )
+        sys.exit(1)
+
+    rps = result["rounds_per_sec"]
+    payload = {
+        "metric": METRIC,
+        "value": round(rps, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": round(rps / baseline_rps, 2) if baseline_rps else None,
+    }
+    if result["clients"] != full_k or result.get("platform") not in (None, "axon", "tpu"):
+        # fallback config: flag it so the number is never mistaken for the
+        # full-K TPU headline (baseline proxy is a K=1000 round, so
+        # vs_baseline is optimistic at reduced K / off-TPU)
+        payload["config"] = f"{result.get('platform', '?')}_k{result['clients']}"
+    if errors:
+        payload["attempt_errors"] = "; ".join(errors)[:500]
+    payload["platform"] = result.get("platform")
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_PROBE") == "1":
+        probe_main()
+    elif os.environ.get("BENCH_CHILD") == "1":
+        child_main()
+    else:
+        main()

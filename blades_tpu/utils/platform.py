@@ -1,0 +1,29 @@
+"""Platform forcing for virtual-device runs.
+
+The TPU plugin's sitecustomize overrides ``jax_platforms`` back to
+``"axon,cpu"`` at interpreter start even when the environment requests CPU,
+so the env var alone is not enough — the config must be updated after
+import. Must run before the first backend touch (``jax.devices()``); once a
+backend is initialized the device list is fixed, in which case this is a
+best-effort no-op.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def force_virtual_cpu(n_devices: int) -> None:
+    """Force an ``n_devices``-device virtual CPU platform (best effort)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already up; caller's device-count checks take over
