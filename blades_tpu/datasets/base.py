@@ -113,7 +113,8 @@ class BaseDataset:
     # -- cache ----------------------------------------------------------------
 
     def _cache_path(self) -> str:
-        meta = f"{self.name}-{self.num_clients}-{self.iid}-{self.alpha}-{self.seed}"
+        # v2: test set shuffled + per-client test_counts added to the archive
+        meta = f"{self.name}-v2-{self.num_clients}-{self.iid}-{self.alpha}-{self.seed}"
         h = hashlib.md5(meta.encode()).hexdigest()[:10]
         return os.path.join(self.data_root, f"{self.name}_part_{h}.npz")
 
@@ -127,8 +128,21 @@ class BaseDataset:
                 z["train_counts"],
                 z["test_x"],
                 z["test_y"],
+                z["test_counts"],
             )
         train_x, train_y, test_x, test_y = self.load_raw()
+        # per-client test shards: shuffle the union then deal evenly, the
+        # reference's scheme (``datasets/cifar10.py:62-68``: seeded shuffle
+        # + np.split; array_split generalizes to non-divisible sizes).
+        # Recorded explicitly in the cache archive (not left to FLDataset's
+        # identical default) so subclasses with real non-even test
+        # partitions can override just this step.
+        t_order = np.random.RandomState(self.seed).permutation(len(test_y))
+        test_x, test_y = test_x[t_order], test_y[t_order]
+        test_counts = np.array(
+            [len(s) for s in np.array_split(np.arange(len(test_y)), self.num_clients)],
+            np.int64,
+        )
         if self.iid:
             xs, ys = partition_iid(train_x, train_y, self.num_clients, self.seed)
         else:
@@ -151,8 +165,9 @@ class BaseDataset:
                 train_counts=counts,
                 test_x=test_x,
                 test_y=test_y,
+                test_counts=test_counts,
             )
-        return px, py, counts, test_x, test_y
+        return px, py, counts, test_x, test_y, test_counts
 
     # -- public ---------------------------------------------------------------
 
@@ -160,7 +175,7 @@ class BaseDataset:
         """Build (or return cached) runtime :class:`FLDataset`. Name kept for
         reference parity (``basedataset.py:98``)."""
         if self._fl is None:
-            px, py, counts, test_x, test_y = self._partition()
+            px, py, counts, test_x, test_y, test_counts = self._partition()
             self._fl = FLDataset(
                 px,
                 py,
@@ -170,5 +185,6 @@ class BaseDataset:
                 transform=self.make_transform(),
                 normalize=self.make_normalize(),
                 pad_id=self.pad_id,
+                test_counts=test_counts,
             )
         return self._fl

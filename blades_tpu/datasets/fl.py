@@ -31,7 +31,12 @@ class FLDataset:
     ----------
     train_x, train_y : per-client padded arrays ``[K, N_max, ...]`` / ``[K, N_max]``.
     train_counts : ``[K]`` true sample counts (padding is never sampled).
-    test_x, test_y : union test set arrays.
+    test_x, test_y : union test set arrays, ordered by owning client:
+        client i owns rows ``[test_offsets[i], test_offsets[i] + test_counts[i])``.
+    test_counts : ``[K]`` per-client test-shard sizes (reference keeps one
+        test set per client, ``src/blades/datasets/dataset.py:80-115``).
+        Defaults to an even split of the union, the reference's built-in
+        partition (``datasets/cifar10.py:67-68``).
     transform : optional jitted per-batch augmentation
         ``(key, x[B, ...]) -> x[B, ...]`` applied at sampling time.
     normalize : optional ``(x) -> x`` cast/normalize applied after transform
@@ -49,6 +54,7 @@ class FLDataset:
         normalize: Optional[Callable] = None,
         client_ids: Optional[List] = None,
         pad_id: Optional[int] = None,
+        test_counts: Optional[np.ndarray] = None,
     ):
         self.train_x = jnp.asarray(train_x)
         self.train_y = jnp.asarray(train_y)
@@ -64,7 +70,27 @@ class FLDataset:
         self.client_ids = (
             list(client_ids) if client_ids is not None else list(range(self.num_clients))
         )
+        n_test = int(self.test_y.shape[0])
+        if test_counts is None:
+            # even split of the union (reference's np.split of the shuffled
+            # test set, ``datasets/cifar10.py:67-68``)
+            test_counts = np.array(
+                [len(s) for s in np.array_split(np.arange(n_test), self.num_clients)],
+                np.int64,
+            )
+        self.test_counts = np.asarray(test_counts, np.int64)
+        if int(self.test_counts.sum()) != n_test:
+            raise ValueError(
+                f"test_counts sum {int(self.test_counts.sum())} != union test "
+                f"size {n_test}"
+            )
+        self.test_offsets = np.concatenate(
+            [[0], np.cumsum(self.test_counts)[:-1]]
+        ).astype(np.int64)
         self._sample_jit: Dict[Tuple[int, int], Callable] = {}
+        # per-client host-side epoch streams for get_train_data (reference
+        # infinite-generator semantics, ``basedataset.py:58-86``)
+        self._streams: Dict[int, dict] = {}
 
     # -- reference-API parity -------------------------------------------------
 
@@ -132,30 +158,50 @@ class FLDataset:
     ) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
         """Reference-API parity (``FLDataset.get_train_data``,
         ``src/blades/datasets/dataset.py:110-112``): pull ``num_batches``
-        batches for one client. The reference draws from a per-client
-        infinite generator; here batches are sampled by key from the
-        client's device-resident rows."""
+        batches for one client from its persistent epoch stream — a fresh
+        without-replacement permutation per epoch, consumed sequentially,
+        reshuffled on wraparound, final batch of an epoch possibly partial
+        (the reference generator, ``basedataset.py:58-86``). ``key``
+        optionally seeds the stream on its first use."""
         i = self.client_ids.index(u_id)
-        if key is None:
-            key = jax.random.PRNGKey(0)
-        n = self.train_counts[i]
-        idx = jax.random.randint(
-            key, (num_batches * batch_size,), 0, jnp.maximum(n, 1)
-        )
-        x = self.train_x[i][idx]
-        if self.normalize is not None:
-            x = self.normalize(x)
-        y = self.train_y[i][idx]
-        xs = x.reshape((num_batches, batch_size) + x.shape[1:])
-        ys = y.reshape(num_batches, batch_size)
-        return [(xs[b], ys[b]) for b in range(num_batches)]
+        n = int(self.train_counts[i])
+        st = self._streams.get(i)
+        if st is None:
+            seed = int(jax.random.randint(key, (), 0, 2**31 - 1)) if key is not None else i
+            rng = np.random.RandomState(seed)
+            st = {"rng": rng, "perm": rng.permutation(max(n, 1)), "pos": 0}
+            self._streams[i] = st
+        batches = []
+        for _ in range(num_batches):
+            if st["pos"] >= n:  # epoch over: reshuffle, restart
+                st["perm"] = st["rng"].permutation(max(n, 1))
+                st["pos"] = 0
+            idx = st["perm"][st["pos"] : st["pos"] + batch_size]
+            st["pos"] += batch_size
+            x = self.train_x[i][idx]
+            if self.normalize is not None:
+                x = self.normalize(x)
+            batches.append((x, self.train_y[i][idx]))
+        return batches
 
-    def get_all_test_data(self, u_id: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        """Reference-API parity (``dataset.py:114-115``). Deviation: the test
-        set is kept as one union array (per-client test shards would only be
-        re-averaged by data size, which equals union metrics — see
-        ``RoundEngine.evaluate``), so every ``u_id`` sees the same data."""
-        return self.test_x, self.test_y
+    def get_all_test_data(self, u_id: Optional[int] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Reference-API parity (``dataset.py:114-115``): the client's own
+        test shard — rows ``[offset, offset + count)`` of the union arrays.
+        With ``u_id=None`` returns the full union test set."""
+        if u_id is None:
+            return self.test_x, self.test_y
+        i = self.client_ids.index(u_id)
+        lo = int(self.test_offsets[i])
+        hi = lo + int(self.test_counts[i])
+        return self.test_x[lo:hi], self.test_y[lo:hi]
+
+    def client_test_slices(self) -> List[np.ndarray]:
+        """Index arrays into the union test set, one per client (real
+        shards, not a synthetic re-split)."""
+        return [
+            np.arange(int(o), int(o) + int(c))
+            for o, c in zip(self.test_offsets, self.test_counts)
+        ]
 
     # -- construction from per-client lists -----------------------------------
 
@@ -163,11 +209,21 @@ class FLDataset:
     def from_client_arrays(
         xs: List[np.ndarray],
         ys: List[np.ndarray],
-        test_x: np.ndarray,
-        test_y: np.ndarray,
+        test_x,
+        test_y,
         **kwargs,
     ) -> "FLDataset":
-        """Build from ragged per-client arrays by padding to ``N_max``."""
+        """Build from ragged per-client arrays by padding to ``N_max``.
+
+        ``test_x``/``test_y`` may be union arrays or per-client lists; lists
+        are concatenated and their lengths recorded as the real per-client
+        test shards."""
+        if isinstance(test_x, (list, tuple)):
+            kwargs.setdefault(
+                "test_counts", np.array([len(t) for t in test_x], np.int64)
+            )
+            test_x = np.concatenate([np.asarray(t) for t in test_x])
+            test_y = np.concatenate([np.asarray(t) for t in test_y])
         k = len(xs)
         counts = np.array([len(x) for x in xs], np.int32)
         n_max = int(counts.max())

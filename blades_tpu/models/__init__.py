@@ -28,6 +28,7 @@ from blades_tpu.models.cct import (
     CCTNet,
 )
 from blades_tpu.models.import_torch import load_torch_checkpoint, torch_cct_to_flax
+from blades_tpu.models.pretrained import MODEL_URLS, fetch_weights, load_pretrained
 from blades_tpu.models.resnet import ResNet18, ResNet34
 from blades_tpu.models.text import (
     TextCCT,
@@ -43,6 +44,7 @@ from blades_tpu.models.text import (
     text_transformer_2,
     text_transformer_4,
     text_transformer_6,
+    long_text_transformer,
 )
 from blades_tpu.models.wrn import WideResNet, wrn_28_10
 
@@ -69,19 +71,35 @@ MODELS: Dict[str, Callable] = {
     "text_vit_4": text_vit_4,
     "text_vit_6": text_vit_6,
     "text_transformer_2": text_transformer_2,
+    "long_text_transformer": long_text_transformer,
     "text_transformer_4": text_transformer_4,
     "text_transformer_6": text_transformer_6,
 }
 
 
-def create_model(name: str, num_classes: int = 10, **kwargs):
+def create_model(name: str, num_classes: int = 10, pretrained=False, **kwargs):
     """Resolve a model by name (reference: per-dataset ``create_model()``
-    factories, e.g. ``models/mnist/dnn.py:22``)."""
+    factories, e.g. ``models/mnist/dnn.py:22``).
+
+    ``pretrained=True`` returns a :class:`ModelSpec` whose ``init`` yields
+    the registered checkpoint's weights (reference ``pretrained=True``
+    kwarg, ``cctnets/cct.py:90-118``); pass a string to pick a different
+    registry entry for the same architecture (e.g.
+    ``create_model("cct_7_3x1_32", num_classes=100,
+    pretrained="cct_7_3x1_32_c100")``). Weights come from the local cache,
+    downloading only on a miss (``models/pretrained.py``).
+    """
     try:
         factory = MODELS[name]
     except KeyError:
         raise ValueError(f"Unknown model {name!r}; available: {sorted(MODELS)}") from None
-    return factory(num_classes=num_classes, **kwargs)
+    model = factory(num_classes=num_classes, **kwargs)
+    if pretrained:
+        from blades_tpu.models.pretrained import pretrained_spec
+
+        weights_name = pretrained if isinstance(pretrained, str) else name
+        return pretrained_spec(weights_name, model)
+    return model
 
 
 __all__ = [
@@ -104,6 +122,9 @@ __all__ = [
     "WideResNet",
     "wrn_28_10",
     "load_torch_checkpoint",
+    "MODEL_URLS",
+    "fetch_weights",
+    "load_pretrained",
     "torch_cct_to_flax",
     "TextCCT",
     "text_cct_2",
@@ -116,6 +137,7 @@ __all__ = [
     "text_vit_4",
     "text_vit_6",
     "text_transformer_2",
+    "long_text_transformer",
     "text_transformer_4",
     "text_transformer_6",
 ]

@@ -1,0 +1,117 @@
+"""Pretrained-weight registry for the Compact-Transformer zoo.
+
+Reference: ``src/blades/models/cifar10/cctnets/cct.py:13-30`` keeps a
+per-variant URL table and ``:90-118`` fetches the torch ``state_dict`` with
+``load_state_dict_from_url`` at model construction when ``pretrained=True``.
+Same contract here: a URL table, an on-disk cache, and a loader that
+converts the torch checkpoint into our flax parameter tree
+(:mod:`blades_tpu.models.import_torch`).
+
+Offline-first: a checkpoint already present in the cache directory
+(``$BLADES_TPU_WEIGHTS`` or ``~/.cache/blades_tpu``) is used without any
+network touch; downloading only happens on a cache miss and can be disabled
+entirely with ``BLADES_TPU_OFFLINE=1`` (zero-egress environments get a
+clear error telling them where to place the file instead).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+# reference cctnets/cct.py:13-30, verbatim variant -> URL table
+MODEL_URLS: Dict[str, str] = {
+    "cct_7_3x1_32":
+        "http://ix.cs.uoregon.edu/~alih/compact-transformers/checkpoints/pretrained/cct_7_3x1_32_cifar10_300epochs.pth",
+    "cct_7_3x1_32_sine":
+        "http://ix.cs.uoregon.edu/~alih/compact-transformers/checkpoints/pretrained/cct_7_3x1_32_sine_cifar10_5000epochs.pth",
+    "cct_7_3x1_32_c100":
+        "http://ix.cs.uoregon.edu/~alih/compact-transformers/checkpoints/pretrained/cct_7_3x1_32_cifar100_300epochs.pth",
+    "cct_7_3x1_32_sine_c100":
+        "http://ix.cs.uoregon.edu/~alih/compact-transformers/checkpoints/pretrained/cct_7_3x1_32_sine_cifar100_5000epochs.pth",
+    "cct_7_7x2_224_sine":
+        "http://ix.cs.uoregon.edu/~alih/compact-transformers/checkpoints/pretrained/cct_7_7x2_224_flowers102.pth",
+    "cct_14_7x2_224":
+        "http://ix.cs.uoregon.edu/~alih/compact-transformers/checkpoints/pretrained/cct_14_7x2_224_imagenet.pth",
+    "cct_14_7x2_384":
+        "http://ix.cs.uoregon.edu/~alih/compact-transformers/checkpoints/finetuned/cct_14_7x2_384_imagenet.pth",
+    "cct_14_7x2_384_fl":
+        "http://ix.cs.uoregon.edu/~alih/compact-transformers/checkpoints/finetuned/cct_14_7x2_384_flowers102.pth",
+}
+
+
+def cache_dir() -> str:
+    return os.environ.get(
+        "BLADES_TPU_WEIGHTS",
+        os.path.join(os.path.expanduser("~"), ".cache", "blades_tpu"),
+    )
+
+
+def weights_path(name: str) -> str:
+    """Cache location of a variant's checkpoint (URL basename)."""
+    if name not in MODEL_URLS:
+        raise ValueError(
+            f"no pretrained weights registered for {name!r}; "
+            f"available: {sorted(MODEL_URLS)}"
+        )
+    return os.path.join(cache_dir(), os.path.basename(MODEL_URLS[name]))
+
+
+def fetch_weights(name: str) -> str:
+    """Return the local checkpoint path, downloading on cache miss."""
+    path = weights_path(name)
+    if os.path.exists(path):
+        return path
+    if os.environ.get("BLADES_TPU_OFFLINE") == "1":
+        raise RuntimeError(
+            f"pretrained weights for {name!r} not cached at {path} and "
+            "downloads are disabled (BLADES_TPU_OFFLINE=1). Fetch "
+            f"{MODEL_URLS[name]} on a connected machine and place it there."
+        )
+    import urllib.request
+
+    os.makedirs(cache_dir(), exist_ok=True)
+    tmp = path + ".part"
+    try:
+        urllib.request.urlretrieve(MODEL_URLS[name], tmp)
+    except Exception as e:  # noqa: BLE001 - fold any fetch error into one message
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise RuntimeError(
+            f"could not download pretrained weights for {name!r} from "
+            f"{MODEL_URLS[name]} ({type(e).__name__}: {e}). In offline "
+            f"environments, place the file at {path} manually."
+        ) from e
+    os.replace(tmp, path)
+    return path
+
+
+def load_pretrained(name: str, params_template: Dict[str, Any]):
+    """Pretrained flax params for ``name``, shaped like ``params_template``."""
+    from blades_tpu.models.import_torch import load_torch_checkpoint
+
+    return load_torch_checkpoint(fetch_weights(name), params_template)
+
+
+def pretrained_spec(name: str, module, sample_shape=(32, 32, 3)):
+    """A :class:`ModelSpec` whose ``init`` returns the pretrained weights.
+
+    The reference mutates the torch module in place
+    (``cct.py:108-116``); in the functional world the natural seam is
+    ``init`` — everything downstream (Simulator, RoundEngine) already
+    consumes specs, so a pretrained model drops in anywhere a fresh one
+    does. A class-count mismatch with the checkpoint head fails with a
+    shape error at load (the reference's ``fc_check`` silently re-inits
+    the head instead; we refuse — silent partial loads are how wrong
+    baselines happen).
+    """
+    from blades_tpu.models.common import build_fns
+
+    spec = build_fns(module, sample_shape)
+    base_init = spec.init
+
+    def init(key):
+        return load_pretrained(name, base_init(key))
+
+    spec.init = init
+    return spec

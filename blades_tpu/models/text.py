@@ -129,12 +129,25 @@ class TextTokenizer(nn.Module):
 
 class MaskedAttention(nn.Module):
     """MHSA with pairwise key/query masking (reference
-    ``utils/transformers.py:39-71``)."""
+    ``utils/transformers.py:39-71``).
+
+    When ``ring_mesh`` is set, attention runs as sequence-parallel ring
+    attention (``ops/ring_attention.py``): the N axis is sharded over
+    ``ring_mesh[ring_axis]`` and K/V blocks rotate via ``lax.ppermute``.
+    Exact same math as the dense path with two deviations: (a) attention
+    dropout is skipped (blockwise-rotating dropout masks are not worth the
+    complexity for a long-context path that is eval/fine-tune focused), and
+    (b) only the key side of the pairwise mask is applied — rows for invalid
+    queries are garbage but every consumer (seq-pool / class token) masks
+    them out downstream, so logits are identical.
+    """
 
     dim: int
     num_heads: int
     attention_dropout: float = 0.1
     projection_dropout: float = 0.1
+    ring_mesh: Optional[object] = None  # jax.sharding.Mesh
+    ring_axis: str = "seq"
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -143,13 +156,22 @@ class MaskedAttention(nn.Module):
         qkv = nn.Dense(self.dim * 3, use_bias=False, kernel_init=_trunc02)(x)
         qkv = qkv.reshape(b, n, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = jnp.einsum("bnhd,bmhd->bhnm", q, k) * (head_dim**-0.5)
-        if mask is not None:
-            pair = mask[:, :, None] & mask[:, None, :]  # [B, N, N]
-            attn = jnp.where(pair[:, None], attn, NEG_INF)
-        attn = jax.nn.softmax(attn, axis=-1)
-        attn = nn.Dropout(self.attention_dropout)(attn, deterministic=deterministic)
-        out = jnp.einsum("bhnm,bmhd->bnhd", attn, v).reshape(b, n, c)
+        if self.ring_mesh is not None:
+            from blades_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(
+                q, k, v, self.ring_mesh, self.ring_axis, kv_mask=mask
+            ).reshape(b, n, c)
+        else:
+            attn = jnp.einsum("bnhd,bmhd->bhnm", q, k) * (head_dim**-0.5)
+            if mask is not None:
+                pair = mask[:, :, None] & mask[:, None, :]  # [B, N, N]
+                attn = jnp.where(pair[:, None], attn, NEG_INF)
+            attn = jax.nn.softmax(attn, axis=-1)
+            attn = nn.Dropout(self.attention_dropout)(
+                attn, deterministic=deterministic
+            )
+            out = jnp.einsum("bhnm,bmhd->bnhd", attn, v).reshape(b, n, c)
         out = nn.Dense(self.dim, kernel_init=_trunc02)(out)
         return nn.Dropout(self.projection_dropout)(out, deterministic=deterministic)
 
@@ -164,11 +186,14 @@ class MaskedTransformerEncoderLayer(nn.Module):
     dropout: float = 0.1
     attention_dropout: float = 0.1
     drop_path_rate: float = 0.1
+    ring_mesh: Optional[object] = None
+    ring_axis: str = "seq"
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
         h = MaskedAttention(
-            self.d_model, self.nhead, self.attention_dropout, self.dropout
+            self.d_model, self.nhead, self.attention_dropout, self.dropout,
+            ring_mesh=self.ring_mesh, ring_axis=self.ring_axis,
         )(nn.LayerNorm()(x), mask=mask, deterministic=deterministic)
         x = x + DropPath(self.drop_path_rate)(h, deterministic=deterministic)
         x = nn.LayerNorm()(x)
@@ -208,6 +233,10 @@ class TextCCT(nn.Module):
     attention_dropout: float = 0.1
     stochastic_depth: float = 0.1
     positional_embedding: str = "sine"  # sine | learnable | none
+    # sequence parallelism: shard the token axis over ring_mesh[ring_axis]
+    # and run ring attention in every encoder layer (ops/ring_attention.py)
+    ring_mesh: Optional[object] = None
+    ring_axis: str = "seq"
 
     @nn.compact
     def __call__(self, tokens, mask=None, train: bool = False):
@@ -272,6 +301,8 @@ class TextCCT(nn.Module):
                 dropout=self.dropout,
                 attention_dropout=self.attention_dropout,
                 drop_path_rate=dpr[i],
+                ring_mesh=self.ring_mesh,
+                ring_axis=self.ring_axis,
             )(x, mask=mask, deterministic=det)
         x = nn.LayerNorm()(x)
 
@@ -355,6 +386,36 @@ def text_vit_4(num_classes: int = 2, **kw) -> TextCCT:
 
 def text_vit_6(num_classes: int = 2, **kw) -> TextCCT:
     return _text("vit", 6, num_classes, **kw)
+
+
+def long_text_transformer(
+    num_classes: int = 2,
+    mesh=None,
+    axis_name: str = "seq",
+    depth: int = 2,
+    **kw,
+) -> TextCCT:
+    """Long-sequence text classifier: ring attention shards the token axis.
+
+    Beyond-parity model family (the reference caps attention at <=256 tokens
+    on one device, ``cctnets/utils/transformers.py:8-37``). Tokenizer-free
+    so the runtime sequence length N is the input length and must be
+    divisible by ``mesh[axis_name]``; seq-pool head (no class token — a
+    prepended token would break the N-divisibility the ring requires).
+    """
+    layers, heads, ratio, _ = _GRID[depth]
+    cfg = dict(
+        num_classes=num_classes,
+        num_layers=layers,
+        num_heads=heads,
+        mlp_ratio=ratio,
+        use_tokenizer=False,
+        seq_pool=True,
+        ring_mesh=mesh,
+        ring_axis=axis_name,
+    )
+    cfg.update(kw)
+    return TextCCT(**cfg)
 
 
 def text_transformer_2(num_classes: int = 2, **kw) -> TextCCT:

@@ -87,6 +87,19 @@ def ring_attention(
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
+    # the replication-check kwarg was renamed check_rep -> check_vma in
+    # jax 0.8; disable it under either name (the online-softmax carry is
+    # intentionally device-varying)
+    import inspect
+
+    smap_params = inspect.signature(shard_map).parameters
+    if "check_vma" in smap_params:
+        check_kw = {"check_vma": False}
+    elif "check_rep" in smap_params:
+        check_kw = {"check_rep": False}
+    else:
+        check_kw = {}
+
     scale = q.shape[-1] ** -0.5
     seq = P(None, axis_name, None, None)
     mask_spec = P(None, axis_name)
@@ -101,7 +114,7 @@ def ring_attention(
         args = (q, k, v)
 
     return shard_map(
-        body, mesh=mesh, in_specs=in_specs, out_specs=seq, check_rep=False
+        body, mesh=mesh, in_specs=in_specs, out_specs=seq, **check_kw
     )(*args)
 
 

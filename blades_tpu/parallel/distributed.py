@@ -26,6 +26,7 @@ must provide; ``jax.make_array_from_process_local_data`` assembles the global
 from __future__ import annotations
 
 import math
+import warnings
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -63,9 +64,54 @@ def initialize(
         # cluster (single-process run) and quietly stay local
         if coordinator_address is not None or num_processes is not None:
             raise
-        if "already initialized" in str(e).lower():
+        msg = str(e).lower()
+        if "already initialized" in msg:
             return
+        if (
+            "coordinator_address should be defined" in msg
+            or "could not be detected" in msg
+            or "no cluster" in msg
+        ):
+            # genuine single-host run: autodetect found no cluster env
+            # (jax raises ValueError("coordinator_address should be
+            # defined.") when no cluster environment is present)
+            return
+        if "before any jax calls" in msg and not _cluster_env_hints():
+            # backend already initialized in a plain single-host process
+            # (tests, notebooks) — harmless; but with cluster env present
+            # this ordering bug WOULD silently fracture a multi-host job,
+            # so only stay quiet when no cluster signals exist
+            return
+        # anything else (coordinator unreachable, partial cluster env,
+        # timeout) must NOT silently degrade a real multi-host job into K
+        # independent single-host trainings — surface it loudly
+        warnings.warn(
+            "jax.distributed.initialize() autodetect failed with an error "
+            f"other than 'no cluster detected': {e!r}. Proceeding "
+            "single-host; if this is a multi-host job, training would "
+            "silently run unsharded — pass coordinator_address/"
+            "num_processes/process_id explicitly.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
         return
+
+
+_CLUSTER_ENV_VARS = (
+    "COORDINATOR_ADDRESS",
+    "JAX_COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "SLURM_JOB_ID",
+    "TPU_WORKER_HOSTNAMES",
+    "TPU_WORKER_ID",
+)
+
+
+def _cluster_env_hints() -> bool:
+    """True when the environment looks like a multi-host cluster job."""
+    import os
+
+    return any(os.environ.get(v) for v in _CLUSTER_ENV_VARS)
 
 
 def make_global_mesh(

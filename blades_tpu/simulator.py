@@ -264,6 +264,9 @@ class Simulator:
         RoundEngine). ``compute_dtype``: ``'bfloat16'`` for mixed-precision
         forward/backward (master weights stay float32).
         """
+        from blades_tpu.utils.xla_cache import enable_compilation_cache
+
+        enable_compilation_cache()
         spec = self._model_spec(model, loss, compute_dtype)
         batch_size = train_batch_size or self._train_bs
 
@@ -410,8 +413,9 @@ class Simulator:
         model on its own test shard (one ``client_validation`` record each,
         ``client.py:144-176``), then the data-size-weighted average is logged
         as the ``test`` record. One batched forward pass computes all of it;
-        test shards are the even split of the union test set (the
-        reference's ``np.split``, ``datasets/cifar10.py:67-68``)."""
+        shards are the clients' real test partitions carried by the dataset
+        (``FLDataset.client_test_slices``; reference keeps one test set per
+        client, ``src/blades/datasets/dataset.py:80-115``)."""
         losses, correct = self.engine.evaluate_per_sample(
             self.server.state,
             self.dataset.test_x,
@@ -419,7 +423,10 @@ class Simulator:
             batch_size=batch_size,
         )
         n = losses.shape[0]
-        shards = np.array_split(np.arange(n), self.dataset.num_clients)
+        if hasattr(self.dataset, "client_test_slices"):
+            shards = self.dataset.client_test_slices()
+        else:
+            shards = np.array_split(np.arange(n), self.dataset.num_clients)
         for u, idx in zip(self._clients, shards):
             if len(idx) == 0:
                 continue

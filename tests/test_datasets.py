@@ -129,8 +129,51 @@ def test_get_train_data_parity_api():
     assert len(batches) == 3
     x, y = batches[0]
     assert x.shape[0] == 8 and y.shape == (8,)
+    # per-client test shard (reference keeps one test set per client);
+    # union recoverable with u_id=None
     tx, ty = fl.get_all_test_data(0)
+    assert tx.shape[0] == ty.shape[0] == 10
+    tx, ty = fl.get_all_test_data(None)
     assert tx.shape[0] == ty.shape[0] == 40
+
+
+def test_get_train_data_without_replacement_epochs():
+    """The epoch stream covers every sample exactly once before reshuffling
+    (reference generator semantics, ``basedataset.py:58-86``)."""
+    from blades_tpu.datasets.fl import FLDataset
+
+    n = 20
+    xs = [np.arange(n, dtype=np.float32).reshape(n, 1)]
+    ys = [np.arange(n, dtype=np.int32)]
+    fl = FLDataset.from_client_arrays(xs, ys, xs[0][:4], ys[0][:4])
+    # one epoch = ceil(20/8) = 3 batches, last partial (len 4)
+    batches = fl.get_train_data(0, num_batches=3, batch_size=8)
+    seen = np.concatenate([np.asarray(y) for _, y in batches])
+    assert len(batches[2][1]) == 4
+    assert sorted(seen.tolist()) == list(range(n))  # without replacement
+    # next epoch: again a full cover, (almost surely) different order
+    batches2 = fl.get_train_data(0, num_batches=3, batch_size=8)
+    seen2 = np.concatenate([np.asarray(y) for _, y in batches2])
+    assert sorted(seen2.tolist()) == list(range(n))
+
+
+def test_per_client_test_shards_non_even():
+    """client_validation shard metrics must come from each client's REAL
+    test shard, including under a non-even split."""
+    from blades_tpu.datasets.fl import FLDataset
+
+    xs = [np.ones((5, 2), np.float32) * i for i in range(3)]
+    ys = [np.full(5, i, np.int32) for i in range(3)]
+    test_xs = [np.ones((j + 1, 2), np.float32) * 10 * j for j in range(3)]
+    test_ys = [np.full(j + 1, j, np.int32) for j in range(3)]
+    fl = FLDataset.from_client_arrays(xs, ys, test_xs, test_ys)
+    assert fl.test_counts.tolist() == [1, 2, 3]
+    slices = fl.client_test_slices()
+    assert [len(s) for s in slices] == [1, 2, 3]
+    for j in range(3):
+        tx, ty = fl.get_all_test_data(j)
+        np.testing.assert_array_equal(np.asarray(ty), test_ys[j])
+        np.testing.assert_array_equal(np.asarray(tx), test_xs[j])
 
 
 def test_set_random_seed_returns_key():

@@ -99,3 +99,31 @@ def test_round_on_distributed_mesh():
     state, m = engine.run_round(state, cx, cy, 0.1, 1.0, jax.random.PRNGKey(2))
     assert np.isfinite(float(m.train_loss))
     dist.sync_global_devices("test")  # single-host barrier must be a no-op
+
+
+def test_initialize_warns_on_coordinator_failure(monkeypatch):
+    """Autodetect failures other than 'no cluster found' must warn loudly
+    instead of silently degrading a multi-host job to single-host."""
+    import warnings
+
+    def boom(**kw):
+        raise RuntimeError("connection to coordinator 10.0.0.1:1234 timed out")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with pytest.warns(RuntimeWarning, match="coordinator"):
+        dist.initialize()
+
+    # the genuine no-cluster case stays quiet
+    def no_cluster(**kw):
+        raise ValueError("coordinator_address should be defined.")
+
+    monkeypatch.setattr(jax.distributed, "initialize", no_cluster)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        dist.initialize()
+
+    # explicit args must re-raise, not warn
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with pytest.raises(RuntimeError):
+        dist.initialize(coordinator_address="10.0.0.1:1234", num_processes=2,
+                        process_id=0)

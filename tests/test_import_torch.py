@@ -90,3 +90,43 @@ def test_variant_mismatch_raises_value_error():
         )
     with pytest.raises(ValueError, match="unrecognized state_dict key"):
         torch_cct_to_flax({"epoch": np.zeros(1)}, p)
+
+
+def test_pretrained_registry_offline_cached(tmp_path, monkeypatch):
+    """create_model(..., pretrained=True) must load from the local cache
+    with no network touch (reference URL registry, cctnets/cct.py:13-30)."""
+    from blades_tpu.models import MODEL_URLS, create_model
+    from blades_tpu.models.pretrained import weights_path
+
+    monkeypatch.setenv("BLADES_TPU_WEIGHTS", str(tmp_path))
+    monkeypatch.setenv("BLADES_TPU_OFFLINE", "1")
+
+    # cache miss while offline: clear, actionable error
+    with pytest.raises(RuntimeError, match="BLADES_TPU_OFFLINE"):
+        create_model("cct_7_3x1_32", pretrained=True).init(jax.random.PRNGKey(0))
+
+    # unknown variant: registry error names the options
+    with pytest.raises(ValueError, match="available"):
+        create_model("cct_2_3x2_32", pretrained=True).init(jax.random.PRNGKey(0))
+
+    if not os.path.isdir(REF):
+        pytest.skip("reference not mounted; cannot fabricate a checkpoint")
+    import sys
+
+    sys.path.insert(0, REF)
+    import torch
+
+    from blades.models.cifar10.cctnets.cct import cct_7_3x1_32 as torch_cct
+
+    tm = torch_cct(pretrained=False, progress=False, num_classes=10, img_size=32)
+    tm.eval()
+    torch.save(tm.state_dict(), weights_path("cct_7_3x1_32"))
+
+    spec = create_model("cct_7_3x1_32", pretrained=True)
+    params = spec.init(jax.random.PRNGKey(0))
+
+    x = np.random.RandomState(1).randn(2, 32, 32, 3).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.tensor(x).permute(0, 3, 1, 2)).numpy()
+    ours = np.asarray(spec.eval_logits_fn(params, jnp.asarray(x)))
+    np.testing.assert_allclose(ours, ref, atol=5e-3, rtol=1e-2)

@@ -76,3 +76,29 @@ def test_long_sequence_memory_shape():
     out = ring_attention(q, k, v, mesh, SEQ)
     ref = attention_reference(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+def test_long_text_transformer_consumes_ring():
+    """The long-context model family routes through ring attention and
+    matches the dense-attention model bit-for-bit in structure (same params,
+    same logits up to fp tolerance)."""
+    from blades_tpu.models import long_text_transformer
+    from blades_tpu.models.text import TextCCT
+
+    mesh = _mesh()
+    model_ring = long_text_transformer(num_classes=4, mesh=mesh)
+    model_full = long_text_transformer(num_classes=4, mesh=None)
+    assert isinstance(model_ring, TextCCT) and model_ring.ring_mesh is mesh
+
+    key = jax.random.PRNGKey(3)
+    tokens = jax.random.randint(key, (2, 64), 0, 1000)
+    lens = jnp.array([[40], [64]])
+    mask = jnp.arange(64)[None, :] < lens
+
+    params = model_full.init(jax.random.PRNGKey(0), tokens, mask)
+    out_full = model_full.apply(params, tokens, mask)
+    out_ring = model_ring.apply(params, tokens, mask)
+    assert out_ring.shape == (2, 4)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_full), atol=3e-5
+    )
