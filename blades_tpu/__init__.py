@@ -19,6 +19,38 @@ from blades_tpu.version import __version__  # noqa: F401
 
 __all__ = ["__version__"]
 
+
+def _honor_cpu_platform_request() -> None:
+    """Re-assert an explicit ``JAX_PLATFORMS=cpu`` request.
+
+    Some accelerator plugins install a sitecustomize that forces
+    ``jax_platforms`` back to their own platform at interpreter start,
+    silently overriding a user's CPU request. Restoring is scoped to the
+    exact value ``"cpu"``: the same sitecustomize also *plants* its own
+    platform into the env var when unset, so any broader "honor the env"
+    rule would faithfully restore the plugin's override — and fight code
+    (like tests/conftest.py) that deliberately set the config after import.
+    """
+    import os
+    import sys
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() != "cpu":
+        return
+    if "jax" not in sys.modules:
+        # jax not imported yet: its own env handling honors the request at
+        # import time; importing it here would defeat the lazy design below
+        # for pure-CLI paths (leaf tools) that never touch jax
+        return
+    try:
+        jax = sys.modules["jax"]
+        if jax.config.jax_platforms != "cpu":
+            jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 - never block import over a config knob
+        pass
+
+
+_honor_cpu_platform_request()
+
 # Top-level re-exports resolve lazily (PEP 562) so that importing a
 # subpackage (e.g. blades_tpu.aggregators) never pays for the full stack.
 _LAZY = {

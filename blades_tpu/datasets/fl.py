@@ -79,6 +79,11 @@ class FLDataset:
                 np.int64,
             )
         self.test_counts = np.asarray(test_counts, np.int64)
+        if len(self.test_counts) != self.num_clients:
+            raise ValueError(
+                f"test_counts has {len(self.test_counts)} entries for "
+                f"{self.num_clients} clients"
+            )
         if int(self.test_counts.sum()) != n_test:
             raise ValueError(
                 f"test_counts sum {int(self.test_counts.sum())} != union test "

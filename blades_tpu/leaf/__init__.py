@@ -11,10 +11,15 @@ split, stats, user pruning — SURVEY.md C11). Same JSON schema
     python -m blades_tpu.leaf.stats --data-dir D
     python -m blades_tpu.leaf.remove_users --data-dir D --out-file F --min-samples 10
 
-(The reference's GDrive ``download_util.py`` is intentionally absent: this
-build performs no network downloads.)
+The reference's GDrive fetcher (``download_util.py``) is ported as
+:mod:`blades_tpu.leaf.download` — offline-gated (``BLADES_TPU_OFFLINE=1``
+raises with manual-placement instructions instead of touching the network).
 """
 
+from blades_tpu.leaf.download import (
+    download_and_extract,
+    download_file_from_google_drive,
+)
 from blades_tpu.leaf.util import iid_divide, read_leaf_dir, write_leaf_json
 
 DATASETS = ["sent140", "femnist", "shakespeare", "celeba", "synthetic", "reddit"]

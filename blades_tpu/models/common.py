@@ -29,13 +29,20 @@ def cross_entropy(logits: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
 
 @dataclasses.dataclass
 class ModelSpec:
-    """Bundle of the pure functions the engine needs, plus init."""
+    """Bundle of the pure functions the engine needs, plus init.
+
+    ``rebuild_ok``: True when ``train_loss_fn``/``eval_logits_fn`` are the
+    stock :func:`build_fns` products (no custom loss or eval logic), so a
+    consumer may regenerate them from ``module`` with different build
+    options (e.g. ``compute_dtype``) without losing behavior.
+    """
 
     module: Any
     init: Callable[[jax.Array], Any]
     train_loss_fn: Callable
     eval_logits_fn: Callable
     param_count: Optional[int] = None
+    rebuild_ok: bool = False
 
 
 def build_fns(
@@ -96,6 +103,7 @@ def build_fns(
         init=init,
         train_loss_fn=train_loss_fn,
         eval_logits_fn=eval_logits_fn,
+        rebuild_ok=True,
     )
 
 

@@ -1,0 +1,10 @@
+"""Reference-path alias: ``blades.models.mnist`` -> here.
+
+The reference exposes the MNIST model as ``from blades.models.mnist import
+MLP`` (``src/blades/models/mnist/dnn.py``); migrating code keeps working
+with the package name swapped.
+"""
+
+from blades_tpu.models.mlp import MLP, create_mnist_model as create_model
+
+__all__ = ["MLP", "create_model"]

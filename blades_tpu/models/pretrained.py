@@ -59,31 +59,16 @@ def weights_path(name: str) -> str:
 
 def fetch_weights(name: str) -> str:
     """Return the local checkpoint path, downloading on cache miss."""
+    from blades_tpu.utils.fetch import fetch_to
+
     path = weights_path(name)
     if os.path.exists(path):
         return path
-    if os.environ.get("BLADES_TPU_OFFLINE") == "1":
-        raise RuntimeError(
-            f"pretrained weights for {name!r} not cached at {path} and "
-            "downloads are disabled (BLADES_TPU_OFFLINE=1). Fetch "
-            f"{MODEL_URLS[name]} on a connected machine and place it there."
-        )
     import urllib.request
 
-    os.makedirs(cache_dir(), exist_ok=True)
-    tmp = path + ".part"
-    try:
-        urllib.request.urlretrieve(MODEL_URLS[name], tmp)
-    except Exception as e:  # noqa: BLE001 - fold any fetch error into one message
-        if os.path.exists(tmp):
-            os.remove(tmp)
-        raise RuntimeError(
-            f"could not download pretrained weights for {name!r} from "
-            f"{MODEL_URLS[name]} ({type(e).__name__}: {e}). In offline "
-            f"environments, place the file at {path} manually."
-        ) from e
-    os.replace(tmp, path)
-    return path
+    url = MODEL_URLS[name]
+    return fetch_to(path, lambda: urllib.request.urlopen(url),
+                    f"pretrained weights {name!r} from {url}")
 
 
 def load_pretrained(name: str, params_template: Dict[str, Any]):

@@ -362,12 +362,33 @@ class Simulator:
 
     def _model_spec(self, model, loss, compute_dtype=None) -> ModelSpec:
         if isinstance(model, ModelSpec):
-            return model
-        sample_shape = tuple(self.dataset.train_x.shape[2:])
+            if compute_dtype is None:
+                return model
+            # the caller asked for a build option the prebuilt spec doesn't
+            # carry (e.g. pretrained spec + bfloat16): rebuild the pure
+            # functions around the same module with the requested options,
+            # keeping the spec's init (which may hold pretrained weights) —
+            # but only when the spec's fns are stock build_fns products;
+            # silently replacing a custom loss/eval fn would train the
+            # wrong objective with no error
+            if not model.rebuild_ok:
+                raise ValueError(
+                    "compute_dtype was requested but this ModelSpec carries "
+                    "custom train/eval functions that a rebuild would "
+                    "discard; build the spec with the desired compute_dtype "
+                    "instead (build_fns(..., compute_dtype=...))"
+                )
+            rebuilt = self._build_spec(model.module, loss, compute_dtype)
+            rebuilt.init = model.init
+            return rebuilt
         if isinstance(model, str):
             from blades_tpu.models import create_model
 
             model = create_model(model, num_classes=self._num_classes)
+        return self._build_spec(model, loss, compute_dtype)
+
+    def _build_spec(self, module, loss, compute_dtype) -> ModelSpec:
+        sample_shape = tuple(self.dataset.train_x.shape[2:])
         # model inputs are whatever the dataset feeds the engine: post-
         # normalize floats for images, raw int token ids for text
         x0 = self.dataset.train_x[:1, :1]
@@ -375,7 +396,7 @@ class Simulator:
             x0 = self.dataset.normalize(x0)
         input_dtype = jnp.int32 if jnp.issubdtype(x0.dtype, jnp.integer) else x0.dtype
         return build_fns(
-            model,
+            module,
             sample_shape,
             loss=loss or "crossentropy",
             input_dtype=input_dtype,

@@ -15,20 +15,39 @@ import os
 
 import jax
 
-DEFAULT_CACHE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    ".jax_cache",
-)
+def _default_cache_dir() -> str:
+    # prefer the repo-local dir when working from a source checkout (fast,
+    # self-contained); fall back to the user cache for pip installs where
+    # the package parent may be read-only site-packages
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    if os.access(repo, os.W_OK) and not repo.rstrip(os.sep).endswith(
+        "site-packages"
+    ):
+        return os.path.join(repo, ".jax_cache")
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "blades_tpu", "jax_cache"
+    )
 
 
-def enable_compilation_cache(cache_dir: str | None = None) -> str:
-    """Turn on the persistent compilation cache (idempotent).
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Turn on the persistent compilation cache (idempotent, best effort).
 
     Caches every program regardless of compile time or size so even the
-    small probe jits hit on re-run.
+    small probe jits hit on re-run. ``BLADES_TPU_NO_CACHE=1`` disables it;
+    an unwritable cache location disables it silently rather than failing
+    the run.
     """
-    cache_dir = cache_dir or os.environ.get("BLADES_TPU_CACHE_DIR", DEFAULT_CACHE_DIR)
-    os.makedirs(cache_dir, exist_ok=True)
+    if os.environ.get("BLADES_TPU_NO_CACHE") == "1":
+        return None
+    cache_dir = cache_dir or os.environ.get(
+        "BLADES_TPU_CACHE_DIR", _default_cache_dir()
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        return None
     for name, value in (
         ("jax_compilation_cache_dir", cache_dir),
         ("jax_persistent_cache_min_compile_time_secs", 0.0),

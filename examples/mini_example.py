@@ -11,6 +11,7 @@ Run with real MNIST under ``./data`` (IDX files or mnist.npz), or pass
 ``--synthetic`` to use the offline stand-in dataset.
 """
 
+import os
 import sys
 
 from blades_tpu.datasets import MNIST, Synthetic
@@ -37,8 +38,9 @@ run_params = {
     "server_optimizer": "SGD",
     "client_optimizer": "SGD",
     "loss": "crossentropy",
-    "global_rounds": 100,
-    "local_steps": 50,
+    # env knobs let the docs gallery execute a reduced run
+    "global_rounds": int(os.environ.get("MINI_ROUNDS", 100)),
+    "local_steps": int(os.environ.get("MINI_STEPS", 50)),
     "server_lr": 1.0,
     "client_lr": 0.1,
 }

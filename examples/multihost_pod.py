@@ -13,6 +13,8 @@ Launch (one command per host, e.g. via gcloud or your cluster runner)::
 Works unchanged on a single host — the distributed init is a no-op there.
 """
 
+import os
+
 import jax
 import numpy as np
 
@@ -24,11 +26,12 @@ from blades_tpu.models.common import build_fns
 from blades_tpu.parallel import distributed as dist
 from blades_tpu.parallel.mesh import make_plan
 
-K = 1024           # client population
-LOCAL_STEPS = 2
-BATCH = 32
-ROUNDS = 10
-SAMPLES_PER_CLIENT = 64
+# env knobs: the docs gallery and smoke runs execute a reduced config
+K = int(os.environ.get("POD_CLIENTS", 1024))           # client population
+LOCAL_STEPS = int(os.environ.get("POD_STEPS", 2))
+BATCH = int(os.environ.get("POD_BATCH", 32))
+ROUNDS = int(os.environ.get("POD_ROUNDS", 10))
+SAMPLES_PER_CLIENT = int(os.environ.get("POD_SAMPLES", 64))
 
 
 def main():

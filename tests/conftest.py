@@ -6,7 +6,9 @@ the reference could not have: it has no tests at all — SURVEY.md section 4).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard assignment, not setdefault: the TPU plugin's sitecustomize plants
+# JAX_PLATFORMS=axon at interpreter start when the var is unset
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

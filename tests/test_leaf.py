@@ -77,3 +77,26 @@ def test_stats(leaf_data):
     assert s["num_users"] == 5
     assert s["num_samples"] == 60
     assert s["min"] == 4 and s["max"] == 20
+
+
+def test_download_offline_gate(tmp_path, monkeypatch):
+    """The GDrive fetcher must refuse (not hang) when offline, and use an
+    already-present archive without any network touch."""
+    import zipfile
+
+    from blades_tpu.leaf.download import (
+        download_and_extract,
+        download_file_from_google_drive,
+    )
+
+    monkeypatch.setenv("BLADES_TPU_OFFLINE", "1")
+    with pytest.raises(RuntimeError, match="BLADES_TPU_OFFLINE"):
+        download_file_from_google_drive("fakeid", str(tmp_path / "x.zip"))
+
+    archive = tmp_path / "dataset.zip"
+    with zipfile.ZipFile(archive, "w") as z:
+        z.writestr("all_data/data.json", '{"users": []}')
+    out = download_and_extract("fakeid", str(tmp_path))
+    assert (tmp_path / "all_data" / "data.json").exists()
+    assert not archive.exists()  # archive removed after extraction
+    assert out == str(tmp_path)
