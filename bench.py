@@ -148,6 +148,8 @@ def child_main() -> None:
 
         devices = jax.devices()
         plan = make_plan(make_mesh(devices)) if len(devices) > 1 else None
+        if plan is not None:
+            ds.place(plan.clients)
         engine = RoundEngine(
             spec.train_loss_fn,
             spec.eval_logits_fn,

@@ -93,9 +93,39 @@ class FLDataset:
             [[0], np.cumsum(self.test_counts)[:-1]]
         ).astype(np.int64)
         self._sample_jit: Dict[Tuple[int, int], Callable] = {}
+        self._sharding = None  # set by place(); constrains sampler outputs
         # per-client host-side epoch streams for get_train_data (reference
         # infinite-generator semantics, ``basedataset.py:58-86``)
         self._streams: Dict[int, dict] = {}
+
+    def place(self, clients_sharding) -> "FLDataset":
+        """Shard the device-resident client arrays over the mesh's clients
+        axis and constrain future ``sample_round`` outputs to the same
+        layout.
+
+        Without this, the ``[K, N_max, ...]`` store lives wherever
+        ``jnp.asarray`` put it and every round's sampled ``[K, S, B, ...]``
+        batch is resharded at the round program's boundary; with it, each
+        device materializes only its own clients' rows and the sampler
+        output lands already laid out (the data-parallel analogue of the
+        reference shipping each actor only its client group,
+        ``simulator.py:223-233``).
+
+        No-op when K is not divisible by the clients-axis width:
+        ``device_put`` requires even divisibility, and the engine's
+        in-graph ``with_sharding_constraint`` path handles the uneven case
+        with implicit padding, so the default layout stays correct.
+        """
+        try:
+            tx = jax.device_put(self.train_x, clients_sharding)
+            ty = jax.device_put(self.train_y, clients_sharding)
+            tc = jax.device_put(self.train_counts, clients_sharding)
+        except ValueError:
+            return self  # uneven K over the mesh: keep the default layout
+        self.train_x, self.train_y, self.train_counts = tx, ty, tc
+        self._sharding = clients_sharding
+        self._sample_jit.clear()  # re-trace with the new output layout
+        return self
 
     # -- reference-API parity -------------------------------------------------
 
@@ -144,6 +174,9 @@ class FLDataset:
                 (self.num_clients, local_steps, batch_size) + cx.shape[2:]
             )
             cy = cy.reshape(self.num_clients, local_steps, batch_size)
+            if self._sharding is not None:
+                cx = jax.lax.with_sharding_constraint(cx, self._sharding)
+                cy = jax.lax.with_sharding_constraint(cy, self._sharding)
             return cx, cy
 
         return sample

@@ -158,6 +158,10 @@ class Simulator:
             if mesh_shape is None:
                 mesh_shape = auto_mesh_shape(len(devices), k)
             self.plan = make_plan(make_mesh(devices, mesh_shape))
+            if hasattr(self.dataset, "place"):
+                # shard the client data store + sampler outputs over the
+                # clients axis so rounds start with data already laid out
+                self.dataset.place(self.plan.clients)
         else:
             self.plan = None
 

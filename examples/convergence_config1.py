@@ -25,7 +25,6 @@ line), ``results/config1/summary.json``, and an accuracy-curve plot at
 from __future__ import annotations
 
 import argparse
-import ast
 import json
 import os
 import shutil
@@ -89,14 +88,12 @@ def run_one(aggregator: str, data_root: str, out_dir: str, rounds: int,
         client_lr=0.1,
         validate_interval=5,
     )
-    stats_src = os.path.join(log_path, "stats")
-    stats_dst = os.path.join(out_dir, f"{tag}_stats")
-    shutil.copyfile(stats_src, stats_dst)
-    tests = [
-        r for r in map(ast.literal_eval, open(stats_dst))
-        if r["_meta"]["type"] == "test"
-    ]
-    return tests, ds_kind
+    from blades_tpu.utils.logging import read_stats
+
+    shutil.copyfile(
+        os.path.join(log_path, "stats"), os.path.join(out_dir, f"{tag}_stats")
+    )
+    return read_stats(log_path, type_filter="test"), ds_kind
 
 
 def plot(curves: dict, path: str) -> None:

@@ -23,7 +23,6 @@ Usage: ``python examples/simulation_on_mnist.py [--rounds 10] [--out DIR]``
 from __future__ import annotations
 
 import argparse
-import ast
 import os
 import sys
 
@@ -43,15 +42,12 @@ AGGS = {
 COLORS = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100", "#e87ba4"]
 
 
-def read_stats(path: str):
-    """Parse a stats log: the ``test`` records (reference ``read_json``)."""
-    out = []
-    with open(path) as f:
-        for line in f:
-            rec = ast.literal_eval(line.strip())
-            if rec["_meta"]["type"] == "test":
-                out.append(rec)
-    return out
+def read_test_records(log_root: str):
+    """The ``test`` records of a run's stats log (reference ``read_json``,
+    "Simulation on MNIST.py" lines 69-83)."""
+    from blades_tpu.utils.logging import read_stats
+
+    return read_stats(log_root, type_filter="test")
 
 
 def main() -> None:
@@ -88,7 +84,7 @@ def main() -> None:
             server_lr=1.0,
             client_lr=0.1,
         )
-        curves[agg] = read_stats(os.path.join(args.out, f"{agg}_logs", "stats"))
+        curves[agg] = read_test_records(os.path.join(args.out, f"{agg}_logs"))
         print(f"{agg}: final top1 = {curves[agg][-1]['top1']:.4f}  ({kind})")
 
     import matplotlib

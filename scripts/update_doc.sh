@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Rebuild the documentation (reference: scripts/update_doc.sh runs the
+# sphinx `make html`, which executes the example gallery). Here the build
+# is `python docs/build.py`: it executes every example and fails on any
+# error, then regenerates docs/gallery.md and docs/api.md in place.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python docs/build.py
+echo "docs rebuilt: docs/gallery.md docs/api.md"

@@ -191,3 +191,24 @@ def test_set_random_seed_returns_key():
     # a valid PRNG key: either new-style typed key or legacy uint32[2]
     is_typed = jnp.issubdtype(k.dtype, jax.dtypes.prng_key)
     assert is_typed or (k.shape == (2,) and k.dtype == jnp.uint32)
+
+
+def test_fldataset_place_shards_over_clients():
+    """place() lays the client store and sampler outputs out over the
+    clients mesh axis — no per-round resharding at the jit boundary."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from blades_tpu.datasets.fl import FLDataset
+
+    k, n = 8, 12
+    xs = [np.random.rand(n, 4, 4, 1).astype(np.float32) for _ in range(k)]
+    ys = [np.random.randint(0, 3, n).astype(np.int32) for _ in range(k)]
+    fl = FLDataset.from_client_arrays(xs, ys, xs[0][:2], ys[0][:2])
+    mesh = Mesh(np.array(jax.devices()).reshape(8, 1), ("clients", "model"))
+    sharding = NamedSharding(mesh, P("clients"))
+    fl.place(sharding)
+    assert fl.train_x.sharding.is_equivalent_to(sharding, fl.train_x.ndim)
+    cx, cy = fl.sample_round(jax.random.PRNGKey(0), 2, 4)
+    assert cx.shape == (k, 2, 4, 4, 4, 1)
+    assert cx.sharding.is_equivalent_to(sharding, cx.ndim)
+    assert cy.sharding.is_equivalent_to(sharding, cy.ndim)

@@ -100,3 +100,41 @@ def test_download_offline_gate(tmp_path, monkeypatch):
     assert (tmp_path / "all_data" / "data.json").exists()
     assert not archive.exists()  # archive removed after extraction
     assert out == str(tmp_path)
+
+
+def test_drive_confirm_form_parsing():
+    from blades_tpu.leaf.download import _parse_confirm_form
+
+    html = '''<html><body>
+    <form id="download-form" action="https://drive.usercontent.google.com/download" method="get">
+    <input type="hidden" name="id" value="FILEID">
+    <input type="hidden" name="confirm" value="t">
+    <input type="hidden" name="uuid" value="abc-123">
+    </form></body></html>'''
+    action, params = _parse_confirm_form(html)
+    assert action == "https://drive.usercontent.google.com/download"
+    assert params == {"id": "FILEID", "confirm": "t", "uuid": "abc-123"}
+    assert _parse_confirm_form("<html>no form here</html>") is None
+
+
+def test_fetch_to_offline_and_cleanup(tmp_path, monkeypatch):
+    import io
+
+    from blades_tpu.utils.fetch import fetch_to
+
+    dest = str(tmp_path / "f.bin")
+    monkeypatch.setenv("BLADES_TPU_OFFLINE", "1")
+    with pytest.raises(RuntimeError, match="BLADES_TPU_OFFLINE"):
+        fetch_to(dest, lambda: io.BytesIO(b"x"), "thing")
+
+    monkeypatch.delenv("BLADES_TPU_OFFLINE")
+    assert fetch_to(dest, lambda: io.BytesIO(b"payload"), "thing") == dest
+    assert open(dest, "rb").read() == b"payload"
+
+    class Boom(io.RawIOBase):
+        def read(self, n=-1):
+            raise OSError("network died")
+
+    with pytest.raises(RuntimeError, match="network died"):
+        fetch_to(str(tmp_path / "g.bin"), lambda: Boom(), "thing")
+    assert not (tmp_path / "g.bin.part").exists()  # tmp cleaned up
