@@ -19,9 +19,13 @@ def force_virtual_cpu(n_devices: int) -> None:
     """Force an ``n_devices``-device virtual CPU platform (best effort)."""
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+        flags = (flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    if "collective_call_terminate_timeout" not in flags:
+        # n virtual devices may timeshare few (or one) physical cores; the
+        # default 40s rendezvous termination timeout hard-aborts the
+        # process under that contention
+        flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+    os.environ["XLA_FLAGS"] = flags
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
         jax.config.update("jax_platforms", "cpu")

@@ -31,10 +31,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOCS = os.path.join(REPO, "docs")
 EXAMPLES = os.path.join(REPO, "examples")
 
+# single CPU device by default: the build host may have ONE core, and an
+# 8-thread virtual mesh there can blow XLA's collective-rendezvous
+# termination timeout mid-example (sharding itself is covered by the test
+# suite); multihost_pod opts back into the mesh with a raised timeout
 CPU_ENV = {
     "JAX_PLATFORMS": "cpu",
-    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
 }
+MESH_FLAGS = (
+    "--xla_force_host_platform_device_count=8 "
+    "--xla_cpu_collective_call_terminate_timeout_seconds=600"
+)
 
 # (filename, argv, env, timeout_s) — reduced but real executions
 GALLERY = [
@@ -47,9 +55,12 @@ GALLERY = [
      ["--rounds", "10", "--out", "@TMP@", "--plot", "@TMP@/config1.png"],
      {}, 900),
     ("simulation_on_mnist.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
+    ("robustness_matrix.py",
+     ["--rounds", "2", "--out", "@TMP@", "--attacks", "ipm", "--aggs",
+      "mean", "geomed"], {}, 900),
     ("multihost_pod.py", [],
      {"POD_CLIENTS": "16", "POD_ROUNDS": "2", "POD_BATCH": "4",
-      "POD_SAMPLES": "8"}, 900),
+      "POD_SAMPLES": "8", "XLA_FLAGS": MESH_FLAGS}, 900),
 ]
 
 API_MODULES = [
@@ -97,7 +108,9 @@ def run_example(name: str, argv: list, extra_env: dict, timeout: int,
     os.makedirs(tmp, exist_ok=True)
     argv = [a.replace("@TMP@", tmp) for a in argv]
     extra_env = {k: v.replace("@TMP@", tmp) for k, v in extra_env.items()}
-    env = dict(os.environ, **CPU_ENV, **extra_env)
+    env = dict(os.environ)
+    env.update(CPU_ENV)
+    env.update(extra_env)  # per-example overrides win (e.g. MESH_FLAGS)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run(
         [sys.executable, os.path.join(EXAMPLES, name), *argv],
@@ -120,9 +133,11 @@ def run_example(name: str, argv: list, extra_env: dict, timeout: int,
     return "\n".join(lines[-15:]), images
 
 
-def build_gallery() -> None:
-    # the gallery IS the examples' CI: refuse to build if a new example was
-    # added without a GALLERY entry (it would silently go unexecuted)
+def check_gallery_covers_examples() -> None:
+    """The gallery IS the examples' CI: refuse to build if a new example
+    was added without a GALLERY entry (it would silently go unexecuted).
+    Runs before any output file is touched so a failure can't leave docs
+    from two different builds."""
     listed = {name for name, _, _, _ in GALLERY}
     on_disk = {f for f in os.listdir(EXAMPLES) if f.endswith(".py")}
     if listed != on_disk:
@@ -130,6 +145,9 @@ def build_gallery() -> None:
             f"examples/ and docs/build.py GALLERY disagree: "
             f"missing={sorted(on_disk - listed)} stale={sorted(listed - on_disk)}"
         )
+
+
+def build_gallery() -> None:
     assets = os.path.join(DOCS, "assets", "gallery")
     os.makedirs(assets, exist_ok=True)
     out = io.StringIO()
@@ -185,11 +203,21 @@ def build_api() -> None:
             sig = ""
             try:
                 import inspect
+                import re
 
-                sig = str(inspect.signature(obj))
+                # normalize default-value reprs that embed memory addresses
+                # (flax sentinels etc.) so rebuilds don't churn the file
+                sig = re.sub(
+                    r"at 0x[0-9a-f]+", "at 0x...", str(inspect.signature(obj))
+                )
             except (TypeError, ValueError):
                 pass
-            summary = pydoc.getdoc(obj).strip()
+            import re
+
+            # docstrings of flax modules embed constructor reprs too
+            summary = re.sub(
+                r"at 0x[0-9a-fA-F]+", "at 0x...", pydoc.getdoc(obj).strip()
+            )
             if not summary:
                 continue
             out.write(f"### `{modname}.{name}{sig}`\n\n")
@@ -201,6 +229,7 @@ def build_api() -> None:
 
 if __name__ == "__main__":
     sys.path.insert(0, REPO)
+    check_gallery_covers_examples()
     build_api()
     build_gallery()
     print("docs build OK")

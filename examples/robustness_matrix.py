@@ -1,0 +1,109 @@
+"""Robustness matrix: every built-in attack against every major defense.
+
+Beyond-parity evidence artifact (the reference's closest analogue is the
+single-config sweep in ``Simulation on MNIST.py``): a grid of attacked
+training runs — {none, noise, labelflipping, signflipping, alie, ipm} ×
+{mean, median, trimmedmean, geomed, krum, clippedclustering} — each run 20
+clients (8 Byzantine) for ``--rounds`` rounds of 10 local steps on the
+MNIST-shaped task, reporting final test top-1 per cell. One command, no
+network, ~25 min on an 8-core CPU mesh.
+
+Outputs: ``results/matrix/matrix.json`` (+ per-run stats logs) and a
+heatmap at ``results/matrix/matrix.png``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ATTACKS = ["none", "noise", "labelflipping", "signflipping", "alie", "ipm"]
+AGGS = ["mean", "median", "trimmedmean", "geomed", "krum", "clippedclustering"]
+K, BYZ = 20, 8
+
+
+def run_cell(attack: str, agg: str, rounds: int, out_dir: str) -> float:
+    from blades_tpu import Simulator
+    from blades_tpu.utils.logging import read_stats
+    from examples.convergence_config1 import build_dataset
+
+    ds, _ = build_dataset(os.path.join(REPO, "data"), num_clients=K, seed=1)
+    log_path = os.path.join(out_dir, f"{attack}__{agg}")
+    sim = Simulator(
+        dataset=ds,
+        aggregator=agg,
+        aggregator_kws={"num_byzantine": BYZ} if agg == "trimmedmean" else {},
+        num_byzantine=0 if attack == "none" else BYZ,
+        attack=None if attack == "none" else attack,
+        log_path=log_path,
+        seed=1,
+    )
+    sim.run(
+        model="mlp",
+        global_rounds=rounds,
+        local_steps=10,
+        server_lr=1.0,
+        client_lr=0.1,
+        validate_interval=rounds,
+    )
+    return float(read_stats(log_path, type_filter="test")[-1]["top1"])
+
+
+def plot(matrix, path: str) -> None:
+    """Sequential single-hue heatmap, per-cell value labels."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    data = np.array([[matrix[a][g] for g in AGGS] for a in ATTACKS])
+    fig, ax = plt.subplots(figsize=(8, 5), dpi=150)
+    im = ax.imshow(data, cmap="Blues", vmin=0.0, vmax=1.0)
+    ax.set_xticks(range(len(AGGS)), AGGS, rotation=30, ha="right")
+    ax.set_yticks(range(len(ATTACKS)), ATTACKS)
+    ax.set_xlabel("Aggregator (defense)")
+    ax.set_ylabel("Attack (8 of 20 clients)")
+    ax.set_title("Final test top-1 after attacked training")
+    for i in range(len(ATTACKS)):
+        for j in range(len(AGGS)):
+            v = data[i, j]
+            ax.text(j, i, f"{100 * v:.0f}", ha="center", va="center",
+                    fontsize=8, color="white" if v > 0.55 else "#333")
+    fig.colorbar(im, ax=ax, shrink=0.8, label="top-1 accuracy")
+    fig.tight_layout()
+    fig.savefig(path)
+    plt.close(fig)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=20)
+    p.add_argument("--out", default=os.path.join(REPO, "results", "matrix"))
+    p.add_argument("--attacks", nargs="*", default=ATTACKS)
+    p.add_argument("--aggs", nargs="*", default=AGGS)
+    args = p.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    matrix = {}
+    for attack in args.attacks:
+        matrix[attack] = {}
+        for agg in args.aggs:
+            top1 = run_cell(attack, agg, args.rounds, args.out)
+            matrix[attack][agg] = top1
+            print(f"{attack:14s} x {agg:18s} -> top1 {top1:.3f}", flush=True)
+
+    with open(os.path.join(args.out, "matrix.json"), "w") as f:
+        json.dump(matrix, f, indent=2)
+    if set(args.attacks) == set(ATTACKS) and set(args.aggs) == set(AGGS):
+        plot(matrix, os.path.join(args.out, "matrix.png"))
+        print("plot:", os.path.join(args.out, "matrix.png"))
+
+
+if __name__ == "__main__":
+    main()

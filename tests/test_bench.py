@@ -1,0 +1,97 @@
+"""bench.py attempt-ladder logic, with the child subprocesses mocked.
+
+The real children are exercised by the driver (BENCH_r*.json) and the
+gate-robustness runs; these tests pin the parent's contract: exactly one
+JSON line on stdout in every world, correct fallback routing, and labels
+that prevent a fallback number from masquerading as the TPU headline.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_main(bench, monkeypatch, capsys, script):
+    """script: list of (result, err) returned by successive _run_child calls."""
+    calls = []
+    seq = iter(script)
+
+    def fake_run_child(overrides, timeout_s):
+        calls.append(dict(overrides))
+        return next(seq)
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    code = 0
+    try:
+        bench.main()
+    except SystemExit as e:
+        code = e.code
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    return json.loads(line), calls, code
+
+
+def test_tpu_headline(bench, monkeypatch, capsys):
+    probe = ({"probe": "ok", "platform": "axon", "n_devices": 1}, None)
+    full = ({"rounds_per_sec": 5.0, "clients": 1000, "platform": "axon"}, None)
+    payload, calls, code = run_main(bench, monkeypatch, capsys, [probe, full])
+    assert code == 0
+    assert payload["value"] == 5.0
+    assert "config" not in payload  # the real headline carries no fallback label
+    assert payload["vs_baseline"] is not None
+
+
+def test_full_timeout_skips_retry_and_falls_to_smoke(bench, monkeypatch, capsys):
+    probe = ({"probe": "ok", "platform": "axon", "n_devices": 1}, None)
+    full_to = (None, "timeout after 1500s")
+    smoke = ({"rounds_per_sec": 8.0, "clients": 100, "platform": "axon"}, None)
+    payload, calls, code = run_main(
+        bench, monkeypatch, capsys, [probe, full_to, smoke]
+    )
+    assert code == 0
+    assert len(calls) == 3  # probe, full, smoke — the identical retry skipped
+    assert payload["config"] == "axon_k100"
+    assert "timeout" in payload["attempt_errors"]
+
+
+def test_probe_failure_routes_to_cpu_smoke(bench, monkeypatch, capsys):
+    probe = (None, "timeout after 240s")
+    cpu = ({"rounds_per_sec": 0.02, "clients": 8, "platform": "cpu"}, None)
+    payload, calls, code = run_main(bench, monkeypatch, capsys, [probe, cpu])
+    assert code == 0
+    assert payload["config"] == "cpu_k8"
+    assert calls[-1]["BENCH_FORCE_CPU"] == 1
+
+
+def test_cpu_only_probe_routes_to_cpu_smoke(bench, monkeypatch, capsys):
+    """A successful probe on a CPU-only host must not run the full ladder."""
+    probe = ({"probe": "ok", "platform": "cpu", "n_devices": 1}, None)
+    cpu = ({"rounds_per_sec": 0.02, "clients": 8, "platform": "cpu"}, None)
+    payload, calls, code = run_main(bench, monkeypatch, capsys, [probe, cpu])
+    assert code == 0
+    assert payload["config"] == "cpu_k8"
+    assert len(calls) == 2
+
+
+def test_total_failure_emits_error_json(bench, monkeypatch, capsys):
+    probe = (None, "timeout after 240s")
+    cpu = (None, "sampler: JaxRuntimeError: boom")
+    payload, calls, code = run_main(bench, monkeypatch, capsys, [probe, cpu])
+    assert code == 1
+    assert payload["value"] is None
+    assert "boom" in payload["error"]
+    assert payload["metric"]  # the line is still schema-complete
