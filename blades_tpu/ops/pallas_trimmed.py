@@ -15,15 +15,20 @@ matrix from HBM:
 Ties are broken by masking exactly the argmax row per lane, mirroring what
 dropping one sorted element does.
 
-``trimmed_mean`` falls back to the sort path off-TPU, when ``2b >= K``, or
-when a ``[K, T]`` block would not fit VMEM; ``interpret=True`` runs the
+``trimmed_mean`` falls back to the sort path off-TPU, when ``2b >= K``,
+when a ``[K, T]`` block would not fit VMEM, or when the Pallas/Mosaic
+toolchain itself cannot compile on this backend (probed once, eagerly, on
+first TPU dispatch — some TPU attachment modes proxy compilation through a
+helper that rejects Mosaic programs, and a kernel that cannot compile must
+not poison the whole round program's compile). ``interpret=True`` runs the
 kernel in interpreter mode (used by CPU tests to validate the kernel logic
-itself).
+itself); ``BLADES_TPU_NO_PALLAS=1`` forces the sort path.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -35,6 +40,10 @@ from jax.experimental import pallas as pl
 # double-buffered input — 500k floats => ~8 MB of ~16 MB VMEM/core.
 _VMEM_BUDGET_FLOATS = 500_000
 _LANES = 128
+# the extraction loop is unrolled (some Mosaic toolchains reject loop
+# constructs in-kernel), so program size is linear in b — cap it to keep
+# compiles bounded; larger trim budgets take the sort path
+_MAX_UNROLL_B = 16
 
 
 def _kernel(u_ref, out_ref, *, b: int, k: int):
@@ -43,13 +52,14 @@ def _kernel(u_ref, out_ref, *, b: int, k: int):
 
     def extract(removed, sign):
         # mark b extrema of `sign` (+1: maxima, -1: minima) as removed,
-        # skipping rows already removed by the other pass
-        def body(_, rem):
-            masked = jnp.where(rem, -jnp.inf, sign * x)
+        # skipping rows already removed by the other pass. b is static and
+        # small, so unroll in Python: cheaper than a loop construct, and
+        # some Mosaic toolchains reject fori_loop inside the kernel
+        for _ in range(b):
+            masked = jnp.where(removed, -jnp.inf, sign * x)
             idx = jnp.argmax(masked, axis=0)  # [T]
-            return rem | (rows == idx[None, :])
-
-        return jax.lax.fori_loop(0, b, body, removed)
+            removed = removed | (rows == idx[None, :])
+        return removed
 
     removed = extract(jnp.zeros(x.shape, bool), 1.0)
     removed = extract(removed, -1.0)
@@ -60,7 +70,12 @@ def _kernel(u_ref, out_ref, *, b: int, k: int):
 
 
 def _block_width(k: int) -> int:
-    t = max(_LANES, (_VMEM_BUDGET_FLOATS // max(k, 1)) // _LANES * _LANES)
+    # prefer 1024-multiples: some Mosaic toolchains only compile multi-block
+    # grids when the lane dimension is >= 1024 (empirically mapped against a
+    # remote-compile helper; narrower multi-block widths were rejected)
+    t = (_VMEM_BUDGET_FLOATS // max(k, 1)) // 1024 * 1024
+    if t == 0:
+        t = max(_LANES, (_VMEM_BUDGET_FLOATS // max(k, 1)) // _LANES * _LANES)
     return min(t, 4096)
 
 
@@ -82,6 +97,48 @@ def _trimmed_mean_pallas(updates: jnp.ndarray, b: int, interpret: bool = False):
     return out[:d]
 
 
+_PROBE_CACHE: dict = {}
+
+
+def _pallas_ok(k: int, d: int, b: int, dtype) -> bool:
+    """Exact-shape probe: can Mosaic compile THIS kernel on this backend?
+
+    A failing kernel inside the jitted round program fails the WHOLE round
+    compile, so AOT-lower-and-compile the exact standalone program first
+    (concrete shapes/dtype only — safe to run even while an outer jit is
+    tracing). The observed failure mode this guards against: TPU
+    attachment modes whose remote compile helper 500s on some Mosaic
+    programs (narrow multi-block grids) while plain XLA works. The
+    fallback costs one failed compile attempt per (k, d, b, dtype)
+    signature per process; with the persistent compilation cache enabled
+    (``utils/xla_cache.py`` — on in every shipped entry point) the probe
+    executable is reused across processes. Necessary, not sufficient: the
+    probe compiles the single-device program, so a toolchain that rejects
+    only the SPMD-partitioned variant inside a sharded round program can
+    still fail the round compile — ``BLADES_TPU_NO_PALLAS=1`` is the
+    escape hatch for that case.
+    """
+    if os.environ.get("BLADES_TPU_NO_PALLAS") == "1":
+        return False
+    key = (k, d, b, jnp.dtype(dtype).name)
+    if key not in _PROBE_CACHE:
+        try:
+            _trimmed_mean_pallas.lower(
+                jax.ShapeDtypeStruct((k, d), dtype), b
+            ).compile()
+            _PROBE_CACHE[key] = True
+        except Exception as e:  # Mosaic/compile-helper failure: use sort path
+            import warnings
+
+            warnings.warn(
+                f"pallas trimmed-mean kernel failed to compile for "
+                f"(K={k}, D={d}, b={b}); falling back to the XLA sort path "
+                f"for this shape. Cause: {type(e).__name__}: {str(e)[:200]}"
+            )
+            _PROBE_CACHE[key] = False
+    return _PROBE_CACHE[key]
+
+
 def trimmed_mean(
     updates: jnp.ndarray,
     b: int,
@@ -96,7 +153,11 @@ def trimmed_mean(
     if b == 0:
         return jnp.mean(updates, axis=0)
     use_kernel = interpret if interpret is not None else (
-        jax.default_backend() == "tpu" and k * _LANES <= _VMEM_BUDGET_FLOATS
+        jax.default_backend() == "tpu"
+        and k - 2 * b > 0  # must precede the probe: never compile a dead kernel
+        and b <= _MAX_UNROLL_B
+        and k * _LANES <= _VMEM_BUDGET_FLOATS
+        and _pallas_ok(k, updates.shape[1], b, updates.dtype)
     )
     if use_kernel and k - 2 * b > 0:
         return _trimmed_mean_pallas(updates, b, interpret=bool(interpret))

@@ -6,6 +6,11 @@ so the env var alone is not enough — the config must be updated after
 import. Must run before the first backend touch (``jax.devices()``); once a
 backend is initialized the device list is fixed, in which case this is a
 best-effort no-op.
+
+This module is the single owner of the virtual-CPU flag recipe: the test
+suite (``tests/conftest.py``), the docs example runner (``docs/build.py``),
+the bench CPU fallback, and the driver dryrun all build their environment
+from the helpers here.
 """
 
 from __future__ import annotations
@@ -14,18 +19,45 @@ import os
 
 import jax
 
+# n virtual devices may timeshare few (or one) physical cores; XLA's default
+# 40 s collective-rendezvous termination timeout hard-aborts the process
+# under that contention
+_COLLECTIVE_TIMEOUT_S = 600
+
+
+def virtual_cpu_flags(n_devices: int, existing: str = "") -> str:
+    """``XLA_FLAGS`` value for an ``n_devices`` virtual CPU platform.
+
+    Appends to ``existing`` without duplicating flags already present.
+    """
+    flags = existing
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    if n_devices > 1 and "collective_call_terminate_timeout" not in flags:
+        flags += (
+            " --xla_cpu_collective_call_terminate_timeout_seconds"
+            f"={_COLLECTIVE_TIMEOUT_S}"
+        )
+    return flags
+
+
+def virtual_cpu_env(n_devices: int) -> dict:
+    """Env-var dict for launching a subprocess on a virtual CPU platform."""
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": virtual_cpu_flags(n_devices),
+    }
+
 
 def force_virtual_cpu(n_devices: int) -> None:
     """Force an ``n_devices``-device virtual CPU platform (best effort)."""
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        flags = (flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
-    if "collective_call_terminate_timeout" not in flags:
-        # n virtual devices may timeshare few (or one) physical cores; the
-        # default 40s rendezvous termination timeout hard-aborts the
-        # process under that contention
-        flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
-    os.environ["XLA_FLAGS"] = flags
+    os.environ["XLA_FLAGS"] = virtual_cpu_flags(
+        n_devices, os.environ.get("XLA_FLAGS", "")
+    )
+    # hard assignment, not setdefault: the TPU plugin's sitecustomize plants
+    # JAX_PLATFORMS=axon at interpreter start when the var is unset
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
         jax.config.update("jax_platforms", "cpu")

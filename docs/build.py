@@ -18,9 +18,11 @@ treat exactly like a failing test).
 
 from __future__ import annotations
 
+import inspect
 import io
 import os
 import pydoc
+import re
 import shutil
 import subprocess
 import sys
@@ -31,18 +33,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOCS = os.path.join(REPO, "docs")
 EXAMPLES = os.path.join(REPO, "examples")
 
+sys.path.insert(0, REPO)
+from blades_tpu.utils.platform import virtual_cpu_env, virtual_cpu_flags  # noqa: E402
+
 # single CPU device by default: the build host may have ONE core, and an
 # 8-thread virtual mesh there can blow XLA's collective-rendezvous
 # termination timeout mid-example (sharding itself is covered by the test
 # suite); multihost_pod opts back into the mesh with a raised timeout
-CPU_ENV = {
-    "JAX_PLATFORMS": "cpu",
-    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-}
-MESH_FLAGS = (
-    "--xla_force_host_platform_device_count=8 "
-    "--xla_cpu_collective_call_terminate_timeout_seconds=600"
-)
+CPU_ENV = virtual_cpu_env(1)
+MESH_FLAGS = virtual_cpu_flags(8)
 
 # (filename, argv, env, timeout_s) — reduced but real executions
 GALLERY = [
@@ -202,18 +201,13 @@ def build_api() -> None:
                 continue
             sig = ""
             try:
-                import inspect
-                import re
-
                 # normalize default-value reprs that embed memory addresses
                 # (flax sentinels etc.) so rebuilds don't churn the file
                 sig = re.sub(
-                    r"at 0x[0-9a-f]+", "at 0x...", str(inspect.signature(obj))
+                    r"at 0x[0-9a-fA-F]+", "at 0x...", str(inspect.signature(obj))
                 )
             except (TypeError, ValueError):
                 pass
-            import re
-
             # docstrings of flax modules embed constructor reprs too
             summary = re.sub(
                 r"at 0x[0-9a-fA-F]+", "at 0x...", pydoc.getdoc(obj).strip()

@@ -27,17 +27,20 @@ AGGS = ["mean", "median", "trimmedmean", "geomed", "krum", "clippedclustering"]
 K, BYZ = 20, 8
 
 
-def run_cell(attack: str, agg: str, rounds: int, out_dir: str) -> float:
+# defenses that take the attacker-budget assumption as a constructor arg;
+# the defender's assumed f is held at the true BYZ for every cell
+BUDGET_AGGS = {"trimmedmean", "krum"}
+
+
+def run_cell(ds, attack: str, agg: str, rounds: int, out_dir: str) -> float:
     from blades_tpu import Simulator
     from blades_tpu.utils.logging import read_stats
-    from examples.convergence_config1 import build_dataset
 
-    ds, _ = build_dataset(os.path.join(REPO, "data"), num_clients=K, seed=1)
     log_path = os.path.join(out_dir, f"{attack}__{agg}")
     sim = Simulator(
         dataset=ds,
         aggregator=agg,
-        aggregator_kws={"num_byzantine": BYZ} if agg == "trimmedmean" else {},
+        aggregator_kws={"num_byzantine": BYZ} if agg in BUDGET_AGGS else {},
         num_byzantine=0 if attack == "none" else BYZ,
         attack=None if attack == "none" else attack,
         log_path=log_path,
@@ -90,17 +93,37 @@ def main() -> None:
     args = p.parse_args()
     os.makedirs(args.out, exist_ok=True)
 
+    from examples.convergence_config1 import build_dataset
+
+    ds, _ = build_dataset(os.path.join(REPO, "data"), num_clients=K, seed=1)
+
+    # merge into any existing matrix so partial re-runs (e.g. one defense
+    # column) refresh the committed artifact instead of truncating it
+    matrix_path = os.path.join(args.out, "matrix.json")
     matrix = {}
+    if os.path.exists(matrix_path):
+        with open(matrix_path) as f:
+            matrix = json.load(f)
+        prev_rounds = matrix.get("_rounds")
+        if matrix and prev_rounds != args.rounds:
+            # an existing file without _rounds has unknown provenance —
+            # refuse that too rather than mislabel mixed-rounds cells
+            sys.exit(
+                f"refusing to merge --rounds {args.rounds} cells into a "
+                f"matrix recorded at {prev_rounds} rounds ({matrix_path}); "
+                "match --rounds or use a fresh --out dir"
+            )
+    matrix["_rounds"] = args.rounds
     for attack in args.attacks:
-        matrix[attack] = {}
+        matrix.setdefault(attack, {})
         for agg in args.aggs:
-            top1 = run_cell(attack, agg, args.rounds, args.out)
+            top1 = run_cell(ds, attack, agg, args.rounds, args.out)
             matrix[attack][agg] = top1
             print(f"{attack:14s} x {agg:18s} -> top1 {top1:.3f}", flush=True)
 
-    with open(os.path.join(args.out, "matrix.json"), "w") as f:
+    with open(matrix_path, "w") as f:
         json.dump(matrix, f, indent=2)
-    if set(args.attacks) == set(ATTACKS) and set(args.aggs) == set(AGGS):
+    if all(agg in matrix.get(a, {}) for a in ATTACKS for agg in AGGS):
         plot(matrix, os.path.join(args.out, "matrix.png"))
         print("plot:", os.path.join(args.out, "matrix.png"))
 

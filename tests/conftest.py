@@ -1,28 +1,23 @@
-"""Test config: force an 8-device virtual CPU mesh before JAX import.
+"""Test config: force an 8-device virtual CPU mesh before JAX backend init.
 
 Multi-chip sharding logic is validated on fake XLA CPU devices (the strategy
 the reference could not have: it has no tests at all — SURVEY.md section 4).
+The flag recipe lives in ``blades_tpu.utils.platform`` (single owner);
+importing it pulls in jax, which is safe — only the first *backend touch*
+freezes the platform, and ``force_virtual_cpu`` runs before that.
 """
 
 import os
+import sys
 
-# hard assignment, not setdefault: the TPU plugin's sitecustomize plants
-# JAX_PLATFORMS=axon at interpreter start when the var is unset
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
-if "collective_call_terminate_timeout" not in _flags:
-    # 8 virtual devices can timeshare a single physical core; XLA's 40s
-    # rendezvous termination timeout hard-aborts under that contention
-    _flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
-os.environ["XLA_FLAGS"] = _flags
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from blades_tpu.utils.platform import force_virtual_cpu  # noqa: E402
+
+force_virtual_cpu(8)
 
 import jax  # noqa: E402
 
-# The axon TPU plugin's sitecustomize forces jax_platforms="axon,cpu" at
-# interpreter start, overriding the env var — override it back after import.
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 
