@@ -80,10 +80,14 @@ def child_main() -> None:
     k = int(os.environ.get("BENCH_CLIENTS", 1000))
     local_steps = int(os.environ.get("BENCH_LOCAL_STEPS", 1))
     batch = int(os.environ.get("BENCH_BATCH", 32))
+    # BASELINE.md config ladder: cct_2_3x2_32 (north star, default) or
+    # resnet18 (configs 2-4 — D≈11M, so K is HBM-bound well below 1000 on
+    # a single chip; pair with BENCH_CLIENTS=100)
+    model_name = os.environ.get("BENCH_MODEL", "cct_2_3x2_32")
     # sequential client chunks bound activation HBM (see RoundEngine
-    # docstring); 10 chunks of 100 clients still push 3200 images per conv
-    # batch to the MXU
-    chunks = int(os.environ.get("BENCH_CHUNKS", 10))
+    # docstring); 4 chunks of 250 clients measured best on v5e (sweep in
+    # docs/performance.md — flat within ~6% from 2 to 20 chunks)
+    chunks = int(os.environ.get("BENCH_CHUNKS", 4))
     # bf16 forward/backward on the MXU (master weights fp32); set
     # BENCH_BF16=0 to benchmark the pure-fp32 path
     bf16 = os.environ.get("BENCH_BF16", "1") != "0"
@@ -120,7 +124,7 @@ def child_main() -> None:
         from blades_tpu.datasets.augment import make_normalizer
         from blades_tpu.datasets.cifar10 import CIFAR10_MEAN, CIFAR10_STD
         from blades_tpu.datasets.fl import FLDataset
-        from blades_tpu.models import cct_2_3x2_32
+        from blades_tpu.models import create_model
         from blades_tpu.models.common import build_fns
         from blades_tpu.parallel.mesh import make_mesh, make_plan
 
@@ -140,7 +144,7 @@ def child_main() -> None:
         )
 
         spec = build_fns(
-            cct_2_3x2_32(num_classes=10),
+            create_model(model_name, num_classes=10),
             sample_shape=(32, 32, 3),
             compute_dtype=jnp.bfloat16 if bf16 else None,
         )
@@ -201,6 +205,7 @@ def child_main() -> None:
                 {
                     "rounds_per_sec": timed / elapsed,
                     "clients": k,
+                    "model": model_name,
                     "train_loss": loss,
                     "platform": devices[0].platform,
                     "n_devices": len(devices),
@@ -254,7 +259,7 @@ def main() -> None:
     full_timeout = float(os.environ.get("BENCH_TIMEOUT", 1500))
     smoke_k = int(os.environ.get("BENCH_SMOKE_CLIENTS", 100))
     smoke_timeout = float(os.environ.get("BENCH_SMOKE_TIMEOUT", 600))
-    chunks = os.environ.get("BENCH_CHUNKS", 10)
+    chunks = os.environ.get("BENCH_CHUNKS", 4)
 
     errors = []
     # liveness probe first: when the TPU tunnel is down, backend init hangs
@@ -340,11 +345,19 @@ def main() -> None:
         "unit": "rounds/sec",
         "vs_baseline": round(rps / baseline_rps, 2) if baseline_rps else None,
     }
-    if result["clients"] != full_k or result.get("platform") not in (None, "axon", "tpu"):
-        # fallback config: flag it so the number is never mistaken for the
-        # full-K TPU headline (baseline proxy is a K=1000 round, so
-        # vs_baseline is optimistic at reduced K / off-TPU)
+    nondefault_model = result.get("model", "cct_2_3x2_32") != "cct_2_3x2_32"
+    if (
+        result["clients"] != full_k
+        or nondefault_model
+        or result.get("platform") not in (None, "axon", "tpu")
+    ):
+        # non-headline config: flag it so the number is never mistaken for
+        # the full-K CCT TPU headline (baseline proxy is a K=1000 CCT
+        # round, so vs_baseline is optimistic/meaningless otherwise)
         payload["config"] = f"{result.get('platform', '?')}_k{result['clients']}"
+        if nondefault_model:
+            payload["config"] += f"_{result['model']}"
+            payload["vs_baseline"] = None
     if errors:
         payload["attempt_errors"] = "; ".join(errors)[:500]
     payload["platform"] = result.get("platform")

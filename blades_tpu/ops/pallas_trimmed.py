@@ -46,27 +46,31 @@ _LANES = 128
 _MAX_UNROLL_B = 16
 
 
-def _kernel(u_ref, out_ref, *, b: int, k: int):
-    x = u_ref[...].astype(jnp.float32)  # [K, T]
-    rows = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+def _trim_survivor_mean(x: jnp.ndarray, b: int, k: int) -> jnp.ndarray:
+    """Shared extraction core: mean of the rows surviving a 2b-extremum trim.
 
-    def extract(removed, sign):
-        # mark b extrema of `sign` (+1: maxima, -1: minima) as removed,
-        # skipping rows already removed by the other pass. b is static and
-        # small, so unroll in Python: cheaper than a loop construct, and
-        # some Mosaic toolchains reject fori_loop inside the kernel
+    Marks b maxima then b minima per column as removed (each pass retires
+    exactly ONE row per column — ties break the way dropping one sorted
+    element does), then sums the SURVIVORS: never summing the trimmed
+    extremes keeps byzantine magnitudes (1e30, inf-scale) out of the
+    arithmetic entirely, exactly like the sort-and-slice path. b is static
+    and small, so unroll in Python: cheaper than a loop construct, and some
+    Mosaic toolchains reject fori_loop inside a kernel. Pure jnp ops only —
+    runs identically inside the Pallas kernel and as a plain XLA program.
+    """
+    rows = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    removed = jnp.zeros(x.shape, bool)
+    for sign in (1.0, -1.0):
         for _ in range(b):
             masked = jnp.where(removed, -jnp.inf, sign * x)
-            idx = jnp.argmax(masked, axis=0)  # [T]
+            idx = jnp.argmax(masked, axis=0)
             removed = removed | (rows == idx[None, :])
-        return removed
+    return jnp.sum(jnp.where(removed, 0.0, x), axis=0) / (k - 2 * b)
 
-    removed = extract(jnp.zeros(x.shape, bool), 1.0)
-    removed = extract(removed, -1.0)
-    # sum the SURVIVORS — never summing the trimmed extremes keeps byzantine
-    # magnitudes (1e30, inf-scale) out of the arithmetic entirely, exactly
-    # like the sort-and-slice path
-    out_ref[...] = jnp.sum(jnp.where(removed, 0.0, x), axis=0) / (k - 2 * b)
+
+def _kernel(u_ref, out_ref, *, b: int, k: int):
+    x = u_ref[...].astype(jnp.float32)  # [K, T]
+    out_ref[...] = _trim_survivor_mean(x, b, k)
 
 
 def _block_width(k: int) -> int:
@@ -127,16 +131,30 @@ def _pallas_ok(k: int, d: int, b: int, dtype) -> bool:
                 jax.ShapeDtypeStruct((k, d), dtype), b
             ).compile()
             _PROBE_CACHE[key] = True
-        except Exception as e:  # Mosaic/compile-helper failure: use sort path
+        except Exception as e:  # Mosaic/compile-helper failure: fall back
             import warnings
 
             warnings.warn(
                 f"pallas trimmed-mean kernel failed to compile for "
-                f"(K={k}, D={d}, b={b}); falling back to the XLA sort path "
-                f"for this shape. Cause: {type(e).__name__}: {str(e)[:200]}"
+                f"(K={k}, D={d}, b={b}); falling back to the plain-XLA "
+                f"extraction path for this shape. "
+                f"Cause: {type(e).__name__}: {str(e)[:200]}"
             )
             _PROBE_CACHE[key] = False
     return _PROBE_CACHE[key]
+
+
+def _trimmed_mean_extract(updates: jnp.ndarray, b: int) -> jnp.ndarray:
+    """Pure-XLA unrolled extremum extraction — the kernel's algorithm
+    (``_trim_survivor_mean``) without Pallas.
+
+    ``2b`` masked argmax passes + one masked sum ≈ ``(2b+1)·K·D·4`` bytes
+    of HBM traffic, versus the multi-pass bitonic sort ``jnp.sort`` lowers
+    to over the K axis (~``log²K`` compare-exchange stages, each a full
+    read+write of the matrix). At the north-star shape (K=1000, D≈284k,
+    b=5) that is ~11 passes instead of ~100.
+    """
+    return _trim_survivor_mean(updates.astype(jnp.float32), b, updates.shape[0])
 
 
 def trimmed_mean(
@@ -147,7 +165,8 @@ def trimmed_mean(
     """Coordinate-wise mean of the middle ``K - 2b`` values per coordinate.
 
     Dispatches to the pallas kernel on TPU (or when ``interpret`` is set);
-    otherwise the ``jnp.sort`` path — both numerically identical.
+    else unrolled extraction in plain XLA for small ``b``; else the
+    ``jnp.sort`` path — all numerically identical.
     """
     k, _ = updates.shape
     if b == 0:
@@ -161,5 +180,7 @@ def trimmed_mean(
     )
     if use_kernel and k - 2 * b > 0:
         return _trimmed_mean_pallas(updates, b, interpret=bool(interpret))
+    if k - 2 * b > 0 and b <= _MAX_UNROLL_B:
+        return _trimmed_mean_extract(updates, b)
     s = jnp.sort(updates, axis=0)
     return jnp.mean(s[b : k - b], axis=0)

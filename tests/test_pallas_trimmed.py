@@ -7,7 +7,13 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from blades_tpu.ops.pallas_trimmed import trimmed_mean, _block_width
+from blades_tpu.ops import pallas_trimmed
+from blades_tpu.ops.pallas_trimmed import (
+    _MAX_UNROLL_B,
+    _block_width,
+    _pallas_ok,
+    trimmed_mean,
+)
 
 
 def _ref(u, b):
@@ -41,6 +47,79 @@ def test_block_width_respects_vmem():
     assert _block_width(1000) * 1000 <= 2_000_000
     assert _block_width(1000) % 128 == 0
     assert _block_width(10) == 4096  # capped
+
+
+def test_block_width_prefers_1024_multiples():
+    # multi-block grids only compile on some Mosaic toolchains when the
+    # lane dim is a 1024 multiple; snap whenever the VMEM budget allows
+    for k in (10, 100, 400):
+        assert _block_width(k) % 1024 == 0
+    # k too large for a 1024-wide block: falls back to 128 alignment
+    assert _block_width(1000) % 128 == 0
+
+
+def test_no_pallas_env_disables_kernel(monkeypatch):
+    monkeypatch.setenv("BLADES_TPU_NO_PALLAS", "1")
+    assert _pallas_ok(16, 256, 2, jnp.float32) is False
+
+
+def test_probe_failure_warns_and_caches(monkeypatch):
+    calls = []
+
+    def boom(*a, **k):
+        calls.append(1)
+        raise RuntimeError("Mosaic says no")
+
+    monkeypatch.setattr(
+        pallas_trimmed._trimmed_mean_pallas, "lower", boom, raising=False
+    )
+    pallas_trimmed._PROBE_CACHE.clear()
+    with pytest.warns(UserWarning, match="falling back to the plain-XLA"):
+        assert _pallas_ok(17, 999, 3, jnp.float32) is False
+    assert _pallas_ok(17, 999, 3, jnp.float32) is False  # cached: no re-probe
+    assert len(calls) == 1
+    pallas_trimmed._PROBE_CACHE.clear()
+
+
+@pytest.mark.parametrize("k,d,b", [(10, 257, 2), (32, 1000, 5), (6, 2, 2)])
+def test_extract_path_matches_sort(k, d, b):
+    from blades_tpu.ops.pallas_trimmed import _trimmed_mean_extract
+
+    rng = np.random.RandomState(3)
+    u = (rng.randn(k, d) * 10).astype(np.float32)
+    out = _trimmed_mean_extract(jnp.asarray(u), b)
+    np.testing.assert_allclose(np.asarray(out), _ref(u, b), rtol=1e-5, atol=1e-5)
+
+
+def test_extract_path_handles_ties_and_extremes():
+    from blades_tpu.ops.pallas_trimmed import _trimmed_mean_extract
+
+    u = np.array([[5.0, 1.0], [5.0, 1.0], [0.0, 1.0], [-5.0, 0.0],
+                  [-5.0, 0.0], [2.0, 0.5]], np.float32)
+    out = _trimmed_mean_extract(jnp.asarray(u), 2)
+    np.testing.assert_allclose(np.asarray(out), _ref(u, 2), atol=1e-6)
+    v = np.random.RandomState(5).randn(10, 33).astype(np.float32)
+    v[0], v[1], v[2] = 1e30, -3e38, 3e38
+    out = _trimmed_mean_extract(jnp.asarray(v), 3)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), _ref(v, 3), rtol=1e-5, atol=1e-5)
+
+
+def test_large_b_takes_sort_path_without_probing(monkeypatch):
+    """b above the unroll cap must never reach the probe (program size is
+    linear in b; a 200-stage kernel compile would be pathological)."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def fail(*a, **k):  # pragma: no cover - reached only on regression
+        raise AssertionError("probe must not run for b > _MAX_UNROLL_B")
+
+    monkeypatch.setattr(pallas_trimmed, "_pallas_ok", fail)
+    k = 3 * _MAX_UNROLL_B
+    u = np.random.RandomState(2).randn(k, 64).astype(np.float32)
+    out = trimmed_mean(jnp.asarray(u), _MAX_UNROLL_B + 1)
+    np.testing.assert_allclose(
+        np.asarray(out), _ref(u, _MAX_UNROLL_B + 1), rtol=1e-5, atol=1e-5
+    )
 
 
 def test_byzantine_magnitudes_do_not_poison_arithmetic():
