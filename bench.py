@@ -86,8 +86,11 @@ def child_main() -> None:
     model_name = os.environ.get("BENCH_MODEL", "cct_2_3x2_32")
     # sequential client chunks bound activation HBM (see RoundEngine
     # docstring); 4 chunks of 250 clients measured best on v5e (sweep in
-    # docs/performance.md — flat within ~6% from 2 to 20 chunks)
+    # docs/performance.md — flat within ~6% from 2 to 20 chunks).
+    # RoundEngine requires k % chunks == 0, so snap to the largest
+    # divisor of k not above the request (BENCH_CLIENTS=50 must not die)
     chunks = int(os.environ.get("BENCH_CHUNKS", 4))
+    chunks = max(c for c in range(1, chunks + 1) if k % c == 0)
     # bf16 forward/backward on the MXU (master weights fp32); set
     # BENCH_BF16=0 to benchmark the pure-fp32 path
     bf16 = os.environ.get("BENCH_BF16", "1") != "0"

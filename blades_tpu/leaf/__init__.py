@@ -10,6 +10,8 @@ split, stats, user pruning — SURVEY.md C11). Same JSON schema
     python -m blades_tpu.leaf.split_data --data-dir D --out-dir O --frac 0.9
     python -m blades_tpu.leaf.stats --data-dir D
     python -m blades_tpu.leaf.remove_users --data-dir D --out-file F --min-samples 10
+    python -m blades_tpu.leaf.preprocess --data-dir D --out-dir O -s niid \
+        --sf 0.1 -k 10 -t sample --tf 0.9   # the preprocess.sh pipeline
 
 The reference's GDrive fetcher (``download_util.py``) is ported as
 :mod:`blades_tpu.leaf.download` — offline-gated (``BLADES_TPU_OFFLINE=1``

@@ -1,5 +1,6 @@
 """Train/test split of LEAF data (reference: ``models/utils/split_data.py``):
-per-user fraction split, preserving the LEAF JSON schema."""
+by-sample (per-user fraction, ``split_data.py:206``) or by-user (held-out
+users, ``split_data.py:163``) split, preserving the LEAF JSON schema."""
 
 from __future__ import annotations
 
@@ -7,6 +8,23 @@ import argparse
 import random
 
 from blades_tpu.leaf.util import read_leaf_dir, write_leaf_json
+
+
+def split_leaf_by_user(data, frac: float = 0.9, seed: int = 0):
+    """Held-out-user split: first ``frac`` of shuffled users train, rest test."""
+    rng = random.Random(seed)
+    users = list(data["users"])
+    rng.shuffle(users)
+    n_train = int(frac * len(users))
+    sides = []
+    for chosen in (users[:n_train], users[n_train:]):
+        side = {"users": [], "num_samples": [], "user_data": {}}
+        for u in chosen:
+            side["users"].append(u)
+            side["num_samples"].append(len(data["user_data"][u]["y"]))
+            side["user_data"][u] = data["user_data"][u]
+        sides.append(side)
+    return tuple(sides)
 
 
 def split_leaf(data, frac: float = 0.9, seed: int = 0):
@@ -37,8 +55,11 @@ def main(argv=None):
     p.add_argument("--out-dir", required=True)
     p.add_argument("--frac", type=float, default=0.9)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--by-user", action="store_true",
+                   help="held-out-user split instead of per-user sample split")
     a = p.parse_args(argv)
-    train, test = split_leaf(read_leaf_dir(a.data_dir), a.frac, a.seed)
+    splitter = split_leaf_by_user if a.by_user else split_leaf
+    train, test = splitter(read_leaf_dir(a.data_dir), a.frac, a.seed)
     write_leaf_json(train, f"{a.out_dir}/train/train.json")
     write_leaf_json(test, f"{a.out_dir}/test/test.json")
     print(

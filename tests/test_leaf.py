@@ -79,6 +79,48 @@ def test_stats(leaf_data):
     assert s["min"] == 4 and s["max"] == 20
 
 
+def test_split_by_user_holds_out_users(leaf_data):
+    from blades_tpu.leaf.split_data import split_leaf_by_user
+
+    data, _ = leaf_data
+    train, test = split_leaf_by_user(data, frac=0.6, seed=0)
+    assert len(train["users"]) == 3 and len(test["users"]) == 2
+    assert not set(train["users"]) & set(test["users"])  # user-disjoint
+    assert sum(train["num_samples"]) + sum(test["num_samples"]) == 60
+    for side in (train, test):  # samples travel whole with their user
+        for u in side["users"]:
+            assert side["user_data"][u] == data["user_data"][u]
+
+
+def test_preprocess_pipeline_and_verify(leaf_data, tmp_path, capsys):
+    from blades_tpu.leaf.preprocess import preprocess, verify
+
+    data, src = leaf_data
+    out = tmp_path / "out"
+    stats = preprocess(
+        str(src), str(out), sample="niid", sample_frac=0.5,
+        min_samples=5, train="sample", train_frac=0.8,
+        sample_seed=1, split_seed=2,
+    )
+    assert (out / "sampled_data" / "sampled.json").exists()
+    assert (out / "rem_user_data" / "pruned.json").exists()
+    assert (out / "train" / "train.json").exists()
+    assert (out / "test" / "test.json").exists()
+    manifest = out / "meta" / "manifest.json"
+    assert manifest.exists()
+    assert stats["num_users"] >= 1
+
+    assert verify(str(out), str(manifest)) is True
+    # corrupt one stage output: verify must fail
+    (out / "train" / "train.json").write_text('{"users": []}')
+    assert verify(str(out), str(manifest)) is False
+
+    # stage-skip idempotency: rerun leaves existing stages untouched
+    preprocess(str(src), str(out), sample="niid", sample_frac=0.5,
+               min_samples=5, train="sample")
+    assert "already been generated" in capsys.readouterr().out
+
+
 def test_download_offline_gate(tmp_path, monkeypatch):
     """The GDrive fetcher must refuse (not hang) when offline, and use an
     already-present archive without any network touch."""
