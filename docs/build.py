@@ -78,6 +78,8 @@ API_MODULES = [
     "blades_tpu.parallel.mesh",
     "blades_tpu.parallel.distributed",
     "blades_tpu.utils.checkpoint",
+    "blades_tpu.leaf",
+    "blades_tpu.leaf.preprocess",
 ]
 
 
