@@ -3,7 +3,8 @@
 Beyond-parity evidence artifact (the reference's closest analogue is the
 single-config sweep in ``Simulation on MNIST.py``): a grid of attacked
 training runs — {none, noise, labelflipping, signflipping, alie, ipm} ×
-{mean, median, trimmedmean, geomed, krum, clippedclustering} — each run 20
+{mean, median, trimmedmean, geomed, krum, clippedclustering, dnc,
+signguard} — each run 20
 clients (8 Byzantine) for ``--rounds`` rounds of 10 local steps on the
 MNIST-shaped task, reporting final test top-1 per cell. One command, no
 network, ~25 min on an 8-core CPU mesh.
@@ -23,13 +24,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 ATTACKS = ["none", "noise", "labelflipping", "signflipping", "alie", "ipm"]
-AGGS = ["mean", "median", "trimmedmean", "geomed", "krum", "clippedclustering"]
+AGGS = ["mean", "median", "trimmedmean", "geomed", "krum",
+        "clippedclustering", "dnc", "signguard"]
 K, BYZ = 20, 8
 
 
 # defenses that take the attacker-budget assumption as a constructor arg;
 # the defender's assumed f is held at the true BYZ for every cell
-BUDGET_AGGS = {"trimmedmean", "krum"}
+BUDGET_AGGS = {"trimmedmean", "krum", "dnc"}
 
 
 def run_cell(ds, attack: str, agg: str, rounds: int, out_dir: str) -> float:
@@ -66,7 +68,7 @@ def plot(matrix, path: str) -> None:
     import numpy as np
 
     data = np.array([[matrix[a][g] for g in AGGS] for a in ATTACKS])
-    fig, ax = plt.subplots(figsize=(8, 5), dpi=150)
+    fig, ax = plt.subplots(figsize=(9.5, 5), dpi=150)
     im = ax.imshow(data, cmap="Blues", vmin=0.0, vmax=1.0)
     ax.set_xticks(range(len(AGGS)), AGGS, rotation=30, ha="right")
     ax.set_yticks(range(len(ATTACKS)), ATTACKS)
