@@ -53,9 +53,12 @@ class Krum(Aggregator):
     def aggregate(self, updates, state=(), **ctx):
         scores = self.scores(updates)
         top_m = jnp.argsort(scores)[: self.m]
-        # the reference *sums* the selected updates (`krum.py:120`); for the
-        # default m=1 this is the single closest vector.
-        return jnp.sum(updates[top_m], axis=0), state
+        # the reference sums the selected updates (`krum.py:120`) but only
+        # ever runs m=1 (`krum.py:114`), where sum == mean == the single
+        # closest vector. The Multi-Krum paper averages the m selected
+        # updates, so for the m>1 surface the reference never exposes we
+        # follow the paper — a sum would scale the pseudo-gradient by m.
+        return jnp.mean(updates[top_m], axis=0), state
 
     def __repr__(self):
         return f"Krum (m={self.m})"

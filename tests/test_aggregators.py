@@ -90,13 +90,16 @@ def test_krum_scores_match_numpy():
     assert (np.argsort(got) == np.argsort(expected)).all()
 
 
-def test_multikrum_sums_selected():
+def test_multikrum_averages_selected():
+    # the Multi-Krum paper AVERAGES the m best-scoring updates; the
+    # reference's sum (`krum.py:120`) only ever runs at m=1 where the two
+    # coincide. Summing at m>1 would scale the pseudo-gradient by m.
     u = rand_updates(k=8, d=5, seed=4)
     agg = Multikrum(num_byzantine=2, num_selected=3)
     scores = np.asarray(agg.scores(u))
     sel = np.argsort(scores)[:3]
     np.testing.assert_allclose(
-        agg(u), np.asarray(u)[sel].sum(0), rtol=1e-4
+        agg(u), np.asarray(u)[sel].mean(0), rtol=1e-4
     )
 
 
@@ -283,3 +286,77 @@ def test_aggregators_jit_compile(name):
     vec, _ = run(u, state)
     assert vec.shape == (16,)
     assert np.isfinite(np.asarray(vec)).all()
+
+
+# -------------------------------------------------- registry-wide properties
+
+# fltrust needs a trusted_mask ctx; handled separately below
+_PROP_AGGS = sorted(set(AGGREGATORS) - {"fltrust"})
+
+
+def _prop_agg(name):
+    kwargs = {"num_byzantine": 2} if name in ("trimmedmean", "krum",
+                                              "multikrum", "dnc") else {}
+    return get_aggregator(name, **kwargs)
+
+
+def _prop_ctx(name, d=11):
+    if name == "dnc":
+        return {"key": jax.random.key(3)}
+    if name == "byzantinesgd":
+        return {"params_flat": jnp.zeros(d)}
+    return {}
+
+
+@pytest.mark.parametrize("name", _PROP_AGGS)
+def test_permutation_invariance(name):
+    """Client order carries no information — every defense must be
+    row-permutation invariant on its FIRST call (stateless view).
+
+    byzantinesgd is exempt: its vector median takes the FIRST row within
+    threshold of a majority (reference ``byzantinesgd.py:35-43`` scans in
+    index order), so the choice among equally eligible rows is
+    order-sensitive by construction.
+    """
+    if name == "byzantinesgd":
+        pytest.skip("first-eligible vector median is order-sensitive by design")
+    u = rand_updates(k=9, d=11, seed=7)
+    perm = np.random.default_rng(1).permutation(9)
+    a = _prop_agg(name)(u, **_prop_ctx(name))
+    b = _prop_agg(name)(u[jnp.asarray(perm)], **_prop_ctx(name))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("name", _PROP_AGGS)
+def test_output_is_finite_and_shaped(name):
+    u = rand_updates(k=9, d=11, seed=8)
+    out = np.asarray(_prop_agg(name)(u, **_prop_ctx(name)))
+    assert out.shape == (11,)
+    assert np.isfinite(out).all()
+
+
+@pytest.mark.parametrize("name", _PROP_AGGS)
+def test_unanimous_updates_are_identity(name):
+    """If every client sends the same vector, any sane aggregate IS that
+    vector (selection, trimming, clustering, and averaging all agree).
+    Stateful EMA-style defenses reach it after a few identical rounds."""
+    if name == "byzantinesgd":
+        pytest.skip("A/B accumulator filter, not an estimator — unanimity "
+                    "maps to its pass-through regime only")
+    v = np.arange(1.0, 12.0, dtype=np.float32)
+    u = jnp.asarray(np.tile(v, (9, 1)))
+    agg = _prop_agg(name)
+    for _ in range(8):  # stateless aggs converge on call 1; EMA ones within 8
+        out = np.asarray(agg(u, **_prop_ctx(name)))
+    np.testing.assert_allclose(out, v, rtol=1e-4, atol=1e-4)
+
+
+def test_fltrust_permutation_invariance_with_mask():
+    u = rand_updates(k=8, d=5, seed=9)
+    mask = jnp.zeros(8, bool).at[3].set(True)
+    perm = np.random.default_rng(2).permutation(8)
+    a = get_aggregator("fltrust")(u, trusted_mask=mask)
+    b = get_aggregator("fltrust")(
+        u[jnp.asarray(perm)], trusted_mask=mask[jnp.asarray(perm)]
+    )
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
