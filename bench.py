@@ -327,18 +327,39 @@ def main() -> None:
         with open(baseline_path) as f:
             baseline_rps = json.load(f)["rounds_per_sec"]
 
-    if result is None:
-        print(
-            json.dumps(
-                {
-                    "metric": METRIC,
-                    "value": None,
-                    "unit": "rounds/sec",
-                    "vs_baseline": None,
-                    "error": "; ".join(errors)[:1000],
-                }
-            )
+    def prior_tpu_capture():
+        """Last committed on-TPU measurement (results/bench_tpu.json), if any.
+
+        Attached (clearly labeled) when the current run could not reach the
+        TPU — the tunnel comes and goes, and a dead tunnel at measurement
+        time should not erase evidence a live window already produced.
+        """
+        path = os.path.join(
+            os.path.dirname(__file__), "results", "bench_tpu.json"
         )
+        try:
+            with open(path) as f:
+                prior = json.load(f)
+            return {
+                "value": prior["value"],
+                "vs_baseline": prior.get("vs_baseline"),
+                "date": prior.get("date"),
+            }
+        except Exception:
+            return None
+
+    if result is None:
+        payload = {
+            "metric": METRIC,
+            "value": None,
+            "unit": "rounds/sec",
+            "vs_baseline": None,
+            "error": "; ".join(errors)[:1000],
+        }
+        prior = prior_tpu_capture()
+        if prior is not None:
+            payload["prior_tpu_capture"] = prior
+        print(json.dumps(payload))
         sys.exit(1)
 
     rps = result["rounds_per_sec"]
@@ -364,6 +385,10 @@ def main() -> None:
     if errors:
         payload["attempt_errors"] = "; ".join(errors)[:500]
     payload["platform"] = result.get("platform")
+    if result.get("platform") == "cpu":
+        prior = prior_tpu_capture()
+        if prior is not None:
+            payload["prior_tpu_capture"] = prior
     print(json.dumps(payload))
 
 
