@@ -1,0 +1,44 @@
+"""Experiment-script smoke tests: the flag system and config surfaces.
+
+The reference's scripts are its only "CLI" (SURVEY.md C16/L6); these tests
+pin the parity pieces that are cheap to check without a training run —
+``parse_arguments`` defaults, derived per-attack/per-aggregator kwarg
+dicts (ref ``scripts/args.py:32-43``), and the config-encoding log-dir
+name (ref ``args.py:44-56``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+from args import parse_arguments  # noqa: E402
+
+
+def test_defaults_match_reference():
+    o = parse_arguments([])
+    assert o.global_round == 400 and o.local_round == 50
+    assert o.agg == "clippedclustering" and o.attack == "signflipping"
+    assert o.num_clients == 20 and o.num_byzantine == 8
+
+
+def test_budget_aggs_receive_byzantine_count():
+    o = parse_arguments(["--num_byzantine", "3"])
+    for name in ("trimmedmean", "krum", "multikrum", "dnc"):
+        assert o.agg_args[name] == {"num_byzantine": 3}
+    assert o.attack_args["ipm"] == {"epsilon": 0.5}
+
+
+def test_log_dir_encodes_config():
+    o = parse_arguments(["--dataset", "cifar10", "--attack", "alie",
+                         "--agg", "median", "--num_byzantine", "5"])
+    assert "cifar10" in o.log_dir
+    assert "b5" in o.log_dir
+    assert "alie" in o.log_dir and "median" in o.log_dir
+
+
+def test_compat_flags_accepted():
+    # GPU-era knobs parse without error and change nothing else
+    o = parse_arguments(["--use-cuda", "--num_gpus", "4", "--num_actors", "10"])
+    assert o.num_gpus == 4  # accepted, ignored downstream
