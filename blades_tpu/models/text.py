@@ -131,9 +131,11 @@ class MaskedAttention(nn.Module):
     """MHSA with pairwise key/query masking (reference
     ``utils/transformers.py:39-71``).
 
-    When ``ring_mesh`` is set, attention runs as sequence-parallel ring
-    attention (``ops/ring_attention.py``): the N axis is sharded over
-    ``ring_mesh[ring_axis]`` and K/V blocks rotate via ``lax.ppermute``.
+    When ``ring_mesh`` is set, attention runs sequence-parallel: the N
+    axis is sharded over ``ring_mesh[ring_axis]`` and either K/V blocks
+    rotate via ``lax.ppermute`` (``seq_parallel="ring"``,
+    ``ops/ring_attention.py``) or two all-to-alls bracket a head-parallel
+    local attention (``seq_parallel="ulysses"``, ``ops/ulysses.py``).
     Exact same math as the dense path with two deviations: (a) attention
     dropout is skipped (blockwise-rotating dropout masks are not worth the
     complexity for a long-context path that is eval/fine-tune focused), and
@@ -148,6 +150,7 @@ class MaskedAttention(nn.Module):
     projection_dropout: float = 0.1
     ring_mesh: Optional[object] = None  # jax.sharding.Mesh
     ring_axis: str = "seq"
+    seq_parallel: str = "ring"  # "ring" | "ulysses"
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -157,9 +160,17 @@ class MaskedAttention(nn.Module):
         qkv = qkv.reshape(b, n, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if self.ring_mesh is not None:
-            from blades_tpu.ops.ring_attention import ring_attention
+            if self.seq_parallel == "ulysses":
+                from blades_tpu.ops.ulysses import ulysses_attention as sp_attn
+            elif self.seq_parallel == "ring":
+                from blades_tpu.ops.ring_attention import ring_attention as sp_attn
+            else:  # a typo must not silently run the wrong schedule
+                raise ValueError(
+                    f"seq_parallel must be 'ring' or 'ulysses', got "
+                    f"{self.seq_parallel!r}"
+                )
 
-            out = ring_attention(
+            out = sp_attn(
                 q, k, v, self.ring_mesh, self.ring_axis, kv_mask=mask
             ).reshape(b, n, c)
         else:
@@ -188,12 +199,14 @@ class MaskedTransformerEncoderLayer(nn.Module):
     drop_path_rate: float = 0.1
     ring_mesh: Optional[object] = None
     ring_axis: str = "seq"
+    seq_parallel: str = "ring"
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
         h = MaskedAttention(
             self.d_model, self.nhead, self.attention_dropout, self.dropout,
             ring_mesh=self.ring_mesh, ring_axis=self.ring_axis,
+            seq_parallel=self.seq_parallel,
         )(nn.LayerNorm()(x), mask=mask, deterministic=deterministic)
         x = x + DropPath(self.drop_path_rate)(h, deterministic=deterministic)
         x = nn.LayerNorm()(x)
@@ -234,9 +247,11 @@ class TextCCT(nn.Module):
     stochastic_depth: float = 0.1
     positional_embedding: str = "sine"  # sine | learnable | none
     # sequence parallelism: shard the token axis over ring_mesh[ring_axis]
-    # and run ring attention in every encoder layer (ops/ring_attention.py)
+    # and run ring ("ring", ops/ring_attention.py) or all-to-all
+    # head-parallel ("ulysses", ops/ulysses.py) attention per encoder layer
     ring_mesh: Optional[object] = None
     ring_axis: str = "seq"
+    seq_parallel: str = "ring"
 
     @nn.compact
     def __call__(self, tokens, mask=None, train: bool = False):
@@ -303,6 +318,7 @@ class TextCCT(nn.Module):
                 drop_path_rate=dpr[i],
                 ring_mesh=self.ring_mesh,
                 ring_axis=self.ring_axis,
+                seq_parallel=self.seq_parallel,
             )(x, mask=mask, deterministic=det)
         x = nn.LayerNorm()(x)
 
@@ -395,13 +411,17 @@ def long_text_transformer(
     depth: int = 2,
     **kw,
 ) -> TextCCT:
-    """Long-sequence text classifier: ring attention shards the token axis.
+    """Long-sequence text classifier: the token axis is sharded over
+    ``mesh[axis_name]``.
 
     Beyond-parity model family (the reference caps attention at <=256 tokens
     on one device, ``cctnets/utils/transformers.py:8-37``). Tokenizer-free
     so the runtime sequence length N is the input length and must be
     divisible by ``mesh[axis_name]``; seq-pool head (no class token — a
-    prepended token would break the N-divisibility the ring requires).
+    prepended token would break the N-divisibility sharding requires).
+    Pass ``seq_parallel="ulysses"`` for all-to-all head-parallel attention
+    (``ops/ulysses.py``, needs heads divisible by the axis size) instead of
+    the default K/V ring (``ops/ring_attention.py``).
     """
     layers, heads, ratio, _ = _GRID[depth]
     cfg = dict(

@@ -67,20 +67,14 @@ def _ring_body(q, k, v, kv_mask, axis_name: str, scale: float):
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-def ring_attention(
-    q: jnp.ndarray,
-    k: jnp.ndarray,
-    v: jnp.ndarray,
-    mesh: Mesh,
-    axis_name: str,
-    kv_mask: Optional[jnp.ndarray] = None,
-) -> jnp.ndarray:
-    """Exact multi-head attention with the sequence axis sharded over
-    ``mesh[axis_name]``.
+def shard_map_seq_attention(body_fn, mesh: Mesh, axis_name: str,
+                            q, k, v, kv_mask):
+    """Shared shard_map harness for sequence-parallel attention bodies.
 
-    ``q``/``k``/``v``: ``[B, N, H, Dh]`` (N divisible by the axis size);
-    ``kv_mask``: optional ``[B, N]`` bool validity mask. Returns ``[B, N, H,
-    Dh]`` sharded like ``q``.
+    ``body_fn(q, k, v, kv_mask, axis_name=..., scale=...)`` runs per-device
+    on ``[B, N/P, H, Dh]`` blocks; used by both the ring
+    (:func:`ring_attention`) and the all-to-all (:mod:`ops.ulysses`)
+    schedules so the jax version shims live in exactly one place.
     """
     try:
         from jax import shard_map
@@ -88,7 +82,7 @@ def ring_attention(
         from jax.experimental.shard_map import shard_map
 
     # the replication-check kwarg was renamed check_rep -> check_vma in
-    # jax 0.8; disable it under either name (the online-softmax carry is
+    # jax 0.8; disable it under either name (the per-device carries are
     # intentionally device-varying)
     import inspect
 
@@ -104,7 +98,7 @@ def ring_attention(
     seq = P(None, axis_name, None, None)
     mask_spec = P(None, axis_name)
     in_specs = (seq, seq, seq) + ((mask_spec,) if kv_mask is not None else ())
-    fn = functools.partial(_ring_body, axis_name=axis_name, scale=scale)
+    fn = functools.partial(body_fn, axis_name=axis_name, scale=scale)
 
     if kv_mask is not None:
         body = lambda q_, k_, v_, mk: fn(q_, k_, v_, mk)
@@ -116,6 +110,24 @@ def ring_attention(
     return shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=seq, **check_kw
     )(*args)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str,
+    kv_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Exact multi-head attention with the sequence axis sharded over
+    ``mesh[axis_name]``.
+
+    ``q``/``k``/``v``: ``[B, N, H, Dh]`` (N divisible by the axis size);
+    ``kv_mask``: optional ``[B, N]`` bool validity mask. Returns ``[B, N, H,
+    Dh]`` sharded like ``q``.
+    """
+    return shard_map_seq_attention(_ring_body, mesh, axis_name, q, k, v, kv_mask)
 
 
 def attention_reference(q, k, v, kv_mask=None):

@@ -1,0 +1,86 @@
+"""Ulysses-style sequence parallelism: all-to-all head-parallel attention.
+
+The second canonical long-context strategy next to ring attention
+(``ops/ring_attention.py``; the reference has neither — its attention runs
+over <=256 tokens on one device, ``cctnets/utils/transformers.py:8-37``).
+Instead of rotating K/V blocks around a ring, two ``lax.all_to_all``
+reshards bracket a fully local attention:
+
+1. sequence-sharded ``[B, N/P, H, Dh]`` → all-to-all (split heads, gather
+   sequence) → ``[B, N, H/P, Dh]``: each device now holds the FULL
+   sequence for its H/P heads;
+2. plain full-softmax attention per device — no online-softmax recurrence,
+   no per-step collectives;
+3. all-to-all back (split sequence, gather heads) → ``[B, N/P, H, Dh]``.
+
+Trade-off vs the ring: two bulk all-to-alls (ICI-friendly, overlap-free)
+instead of P ``ppermute`` hops interleaved with compute, and O(N) (not
+O(N/P)) activation memory for the local attention — the right choice when
+heads are plentiful and the per-device sequence fits, while the ring wins
+at extreme N. Requires ``H % P == 0``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from blades_tpu.ops.ring_attention import NEG_INF, shard_map_seq_attention
+
+
+def _ulysses_body(q, k, v, kv_mask, axis_name: str, scale: float):
+    """Per-device program: reshard to head-parallel, attend, reshard back."""
+    # [B, N/P, H, Dh] -> [B, N, H/P, Dh]: split the head axis across
+    # devices, concatenate the received sequence blocks
+    q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if kv_mask is not None:
+        # each device holds [B, N/P] of the mask; attention needs all N
+        full_mask = lax.all_gather(
+            kv_mask, axis_name, axis=1, tiled=True
+        )  # [B, N]
+        s = jnp.where(full_mask[:, None, None, :], s, NEG_INF)
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+    # cast BEFORE the reverse reshard: under bf16 inputs this halves the
+    # bytes the second all-to-all moves over ICI
+    out = out.astype(q.dtype)
+    # [B, N, H/P, Dh] -> [B, N/P, H, Dh]
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str,
+    kv_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Exact multi-head attention, sequence axis sharded over
+    ``mesh[axis_name]``, computed head-parallel via two all-to-alls.
+
+    Same contract as :func:`ring_attention`: ``q``/``k``/``v`` are
+    ``[B, N, H, Dh]`` with N divisible by the axis size; additionally H
+    must be divisible by the axis size. ``kv_mask``: optional ``[B, N]``
+    bool validity mask. Returns ``[B, N, H, Dh]`` sharded like ``q``.
+    """
+    n_dev = mesh.shape[axis_name]
+    if q.shape[2] % n_dev:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by the "
+            f"'{axis_name}' axis size ({n_dev}); use ring_attention instead"
+        )
+    return shard_map_seq_attention(
+        _ulysses_body, mesh, axis_name, q, k, v, kv_mask
+    )

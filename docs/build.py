@@ -75,6 +75,7 @@ API_MODULES = [
     "blades_tpu.models",
     "blades_tpu.models.pretrained",
     "blades_tpu.ops.ring_attention",
+    "blades_tpu.ops.ulysses",
     "blades_tpu.parallel.mesh",
     "blades_tpu.parallel.distributed",
     "blades_tpu.utils.checkpoint",
