@@ -60,6 +60,8 @@ GALLERY = [
     ("multihost_pod.py", [],
      {"POD_CLIENTS": "16", "POD_ROUNDS": "2", "POD_BATCH": "4",
       "POD_SAMPLES": "8", "XLA_FLAGS": MESH_FLAGS}, 900),
+    ("long_context.py", [],
+     {"LC_SEQ": "128", "LC_BATCH": "2", "XLA_FLAGS": MESH_FLAGS}, 900),
 ]
 
 API_MODULES = [
