@@ -76,6 +76,17 @@ def _maybe_force_cpu() -> None:
         force_virtual_cpu(int(os.environ.get("BENCH_CPU_DEVICES", 8)))
 
 
+def _make_agg(get_aggregator, agg_name: str, num_byz: int, explicit: bool):
+    """Construct the aggregator, forwarding BENCH_NUM_BYZ to the ones whose
+    constructor keys on f (krum/trimmedmean/dnc); the rest take defaults."""
+    if explicit:
+        try:
+            return get_aggregator(agg_name, num_byzantine=num_byz)
+        except TypeError:
+            pass
+    return get_aggregator(agg_name)
+
+
 def child_main() -> None:
     k = int(os.environ.get("BENCH_CLIENTS", 1000))
     local_steps = int(os.environ.get("BENCH_LOCAL_STEPS", 1))
@@ -88,9 +99,19 @@ def child_main() -> None:
     # docstring); 4 chunks of 250 clients measured best on v5e (sweep in
     # docs/performance.md — flat within ~6% from 2 to 20 chunks).
     # RoundEngine requires k % chunks == 0, so snap to the largest
-    # divisor of k not above the request (BENCH_CLIENTS=50 must not die)
-    chunks = int(os.environ.get("BENCH_CHUNKS", 4))
+    # divisor of k not above the request (BENCH_CLIENTS=50 must not die);
+    # clamp first so BENCH_CHUNKS=0 is a clear floor, not an empty max()
+    chunks = max(1, int(os.environ.get("BENCH_CHUNKS", 4)))
     chunks = max(c for c in range(1, chunks + 1) if k % c == 0)
+    # BASELINE.md config-ladder knobs (configs 2-5 pair resnet18/wrn_28_10
+    # with specific aggregator/attack/client-opt combinations)
+    agg_name = os.environ.get("BENCH_AGG", "trimmedmean")
+    attack_name = os.environ.get("BENCH_ATTACK", "") or None
+    num_byz_env = os.environ.get("BENCH_NUM_BYZ")
+    num_byz = int(num_byz_env) if num_byz_env else 0
+    client_opt_name = os.environ.get("BENCH_CLIENT_OPT", "sgd")
+    num_classes = int(os.environ.get("BENCH_NUM_CLASSES", 10))
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR") or None
     # bf16 forward/backward on the MXU (master weights fp32); set
     # BENCH_BF16=0 to benchmark the pure-fp32 path
     bf16 = os.environ.get("BENCH_BF16", "1") != "0"
@@ -123,6 +144,7 @@ def child_main() -> None:
 
         stage = "build"
         from blades_tpu.aggregators import get_aggregator
+        from blades_tpu.attackers import get_attack
         from blades_tpu.core import ClientOptSpec, RoundEngine, ServerOptSpec
         from blades_tpu.datasets.augment import make_normalizer
         from blades_tpu.datasets.cifar10 import CIFAR10_MEAN, CIFAR10_STD
@@ -135,7 +157,9 @@ def child_main() -> None:
         train_x = rng.randint(
             0, 256, (k, SAMPLES_PER_CLIENT, 32, 32, 3), dtype=np.uint8
         )
-        train_y = rng.randint(0, 10, (k, SAMPLES_PER_CLIENT)).astype(np.int32)
+        train_y = rng.randint(0, num_classes, (k, SAMPLES_PER_CLIENT)).astype(
+            np.int32
+        )
         counts = np.full(k, SAMPLES_PER_CLIENT, np.int32)
         ds = FLDataset(
             train_x,
@@ -147,7 +171,7 @@ def child_main() -> None:
         )
 
         spec = build_fns(
-            create_model(model_name, num_classes=10),
+            create_model(model_name, num_classes=num_classes),
             sample_shape=(32, 32, 3),
             compute_dtype=jnp.bfloat16 if bf16 else None,
         )
@@ -162,11 +186,17 @@ def child_main() -> None:
             spec.eval_logits_fn,
             params,
             num_clients=k,
-            num_byzantine=0,
-            aggregator=get_aggregator("trimmedmean"),
-            client_opt=ClientOptSpec(),
+            num_byzantine=num_byz,
+            attack=get_attack(attack_name) if attack_name else None,
+            # aggregators that key on f (krum/trimmedmean/...) must see the
+            # actual byzantine count; default construction (headline path)
+            # keeps each aggregator's own reference-parity default
+            aggregator=_make_agg(
+                get_aggregator, agg_name, num_byz, bool(num_byz_env)
+            ),
+            client_opt=ClientOptSpec(name=client_opt_name),
             server_opt=ServerOptSpec(),
-            num_classes=10,
+            num_classes=num_classes,
             plan=plan,
             client_chunks=chunks,
             remat=True,
@@ -193,11 +223,15 @@ def child_main() -> None:
         jax.block_until_ready(state.params)
 
         stage = "timed"
+        if profile_dir:
+            jax.profiler.start_trace(profile_dir)
         t0 = time.time()
         for r in range(warmup, warmup + timed):
             state, m = one_round(state, r)
         jax.block_until_ready(state.params)
         elapsed = time.time() - t0
+        if profile_dir:
+            jax.profiler.stop_trace()
 
         loss = float(m.train_loss)
         if not np.isfinite(loss):
@@ -209,6 +243,11 @@ def child_main() -> None:
                     "rounds_per_sec": timed / elapsed,
                     "clients": k,
                     "model": model_name,
+                    "agg": agg_name,
+                    "attack": attack_name,
+                    "num_byz": num_byz,
+                    "client_opt": client_opt_name,
+                    "local_steps": local_steps,
                     "train_loss": loss,
                     "platform": devices[0].platform,
                     "n_devices": len(devices),
@@ -370,9 +409,20 @@ def main() -> None:
         "vs_baseline": round(rps / baseline_rps, 2) if baseline_rps else None,
     }
     nondefault_model = result.get("model", "cct_2_3x2_32") != "cct_2_3x2_32"
+    nondefault_agg = result.get("agg", "trimmedmean") != "trimmedmean"
+    # any attacked / Adam-client / multi-step variant is not the headline
+    # either — never let those ride under the clean-headline metric name
+    nondefault_run = (
+        result.get("attack") not in (None, "")
+        or result.get("num_byz", 0)
+        or result.get("client_opt", "sgd") != "sgd"
+        or result.get("local_steps", 1) != 1
+    )
     if (
         result["clients"] != full_k
         or nondefault_model
+        or nondefault_agg
+        or nondefault_run
         or result.get("platform") not in (None, "axon", "tpu")
     ):
         # non-headline config: flag it so the number is never mistaken for
@@ -381,6 +431,16 @@ def main() -> None:
         payload["config"] = f"{result.get('platform', '?')}_k{result['clients']}"
         if nondefault_model:
             payload["config"] += f"_{result['model']}"
+            payload["vs_baseline"] = None
+        if nondefault_agg:
+            payload["config"] += f"_{result['agg']}"
+        if nondefault_run:
+            payload["config"] += (
+                f"_{result.get('attack') or 'noattack'}"
+                f"_byz{result.get('num_byz', 0)}"
+                f"_{result.get('client_opt', 'sgd')}"
+                f"_ls{result.get('local_steps', 1)}"
+            )
             payload["vs_baseline"] = None
     if errors:
         payload["attempt_errors"] = "; ".join(errors)[:500]

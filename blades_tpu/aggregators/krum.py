@@ -67,5 +67,11 @@ class Krum(Aggregator):
 class Multikrum(Krum):
     """Multi-Krum: select the m best-scoring clients (m > 1)."""
 
-    def __init__(self, num_clients: int = None, num_byzantine: int = 5, num_selected: int = 5):
-        super().__init__(num_clients, num_byzantine, num_selected)
+    def __init__(
+        self,
+        num_clients: int = None,
+        num_byzantine: int = 5,
+        num_selected: int = 5,
+        distance_power: int = 2,
+    ):
+        super().__init__(num_clients, num_byzantine, num_selected, distance_power)

@@ -21,11 +21,13 @@ class Attack:
 
     Hyperparameters are plain Python attributes (static under jit). Hooks:
 
-    ``on_batch(x, y, is_byz, num_classes, key)``
+    ``on_batch(x, y, is_byz, num_classes, key, client_idx)``
         Per-train-step data corruption inside the vmapped client step.
-        ``is_byz`` is a scalar bool for the current client (under vmap).
+        ``is_byz`` is a scalar bool and ``client_idx`` a scalar int32 for the
+        current client (under vmap); built-in uniform attacks ignore
+        ``client_idx``, per-client composites dispatch on it.
 
-    ``on_grads(grads, is_byz)``
+    ``on_grads(grads, is_byz, client_idx)``
         Per-step gradient corruption (pytree in, pytree out).
 
     ``on_updates(updates, byz_mask, key, state)``
@@ -48,10 +50,13 @@ class Attack:
         *,
         num_classes: int,
         key: jax.Array,
+        client_idx: jnp.ndarray = None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         return x, y
 
-    def on_grads(self, grads: Any, is_byz: jnp.ndarray) -> Any:
+    def on_grads(
+        self, grads: Any, is_byz: jnp.ndarray, client_idx: jnp.ndarray = None
+    ) -> Any:
         return grads
 
     def on_updates(

@@ -19,6 +19,6 @@ class Labelflipping(Attack):
     def __init__(self, num_classes: int = 10):
         self.num_classes = int(num_classes)
 
-    def on_batch(self, x, y, is_byz, *, num_classes, key):
+    def on_batch(self, x, y, is_byz, *, num_classes, key, client_idx=None):
         n = num_classes or self.num_classes
         return x, jnp.where(is_byz, n - 1 - y, y)

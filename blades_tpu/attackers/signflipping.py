@@ -17,6 +17,6 @@ from blades_tpu.attackers.base import Attack
 class Signflipping(Attack):
     trains_dishonestly = True
 
-    def on_grads(self, grads, is_byz):
+    def on_grads(self, grads, is_byz, client_idx=None):
         sign = jnp.where(is_byz, -1.0, 1.0)
         return jax.tree_util.tree_map(lambda g: g * sign.astype(g.dtype), grads)

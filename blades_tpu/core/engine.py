@@ -233,8 +233,10 @@ class RoundEngine:
 
     # -- the round program ---------------------------------------------------
 
-    def _local_update(self, params, opt_state, lr, cx, cy, ckey, is_byz):
-        """One client's local training; vmapped over the K axis."""
+    def _local_update(self, params, opt_state, lr, cx, cy, ckey, is_byz, idx):
+        """One client's local training; vmapped over the K axis. ``idx`` is
+        the client's global index (lets per-client composite attacks dispatch
+        their own batch/grad hooks)."""
         flat0 = ravel(params)
         if not self.client_opt.persist:
             opt_state = self._client_tx.init(params)
@@ -243,9 +245,18 @@ class RoundEngine:
             p, ost, i = carry
             x, y = batch
             bkey = jax.random.fold_in(ckey, i)
-            x, y = self.attack.on_batch(
-                x, y, is_byz, num_classes=self.num_classes, key=bkey
-            )
+            # client_idx lets per-client composites dispatch; user attacks
+            # written against the original hook signature (no client_idx)
+            # keep working via the TypeError fallback (trace-time only)
+            try:
+                x, y = self.attack.on_batch(
+                    x, y, is_byz, num_classes=self.num_classes, key=bkey,
+                    client_idx=idx,
+                )
+            except TypeError:
+                x, y = self.attack.on_batch(
+                    x, y, is_byz, num_classes=self.num_classes, key=bkey
+                )
 
             def clamped_loss(p_):
                 out = self.train_loss_fn(p_, x, y, bkey)
@@ -257,7 +268,10 @@ class RoundEngine:
             if self.remat:
                 clamped_loss = jax.checkpoint(clamped_loss)
             (loss, aux), grads = jax.value_and_grad(clamped_loss, has_aux=True)(p)
-            grads = self.attack.on_grads(grads, is_byz)
+            try:
+                grads = self.attack.on_grads(grads, is_byz, client_idx=idx)
+            except TypeError:
+                grads = self.attack.on_grads(grads, is_byz)
             updates, ost = self._client_tx.update(grads, ost, p)
             p = jax.tree_util.tree_map(
                 lambda a, u: a - lr * u.astype(a.dtype), p, updates
@@ -280,16 +294,18 @@ class RoundEngine:
             cy = lax.with_sharding_constraint(cy, self.plan.clients)
 
         if self.client_opt.persist:
-            in_axes = (None, 0, None, 0, 0, 0, 0)
+            in_axes = (None, 0, None, 0, 0, 0, 0, 0)
             opt_arg = state.client_opt_state
         else:
-            in_axes = (None, None, None, 0, 0, 0, 0)
+            in_axes = (None, None, None, 0, 0, 0, 0, 0)
             opt_arg = ()
         vmapped = jax.vmap(self._local_update, in_axes=in_axes)
+        client_ids = jnp.arange(self.num_clients, dtype=jnp.int32)
 
         if self.client_chunks == 1:
             updates, new_client_opt, losses, top1s = vmapped(
-                state.params, opt_arg, client_lr, cx, cy, client_keys, self.byz_mask
+                state.params, opt_arg, client_lr, cx, cy, client_keys,
+                self.byz_mask, client_ids,
             )
         else:
             # HBM lever: sequential lax.map over client chunks, vmap inside.
@@ -306,14 +322,14 @@ class RoundEngine:
             opt_c = chunked(opt_arg) if self.client_opt.persist else opt_arg
 
             def run_chunk(args):
-                o, x, y, k, b = args
+                o, x, y, k, b, ids = args
                 return vmapped(state.params, o if self.client_opt.persist else (),
-                               client_lr, x, y, k, b)
+                               client_lr, x, y, k, b, ids)
 
             updates, new_client_opt, losses, top1s = lax.map(
                 run_chunk,
                 (opt_c, chunked(cx), chunked(cy), chunked(client_keys),
-                 chunked(self.byz_mask)),
+                 chunked(self.byz_mask), chunked(client_ids)),
             )
 
             def unchunk(t):

@@ -112,11 +112,9 @@ def preprocess(
                 data,
                 fraction=sample_frac if sample_frac is not None else 0.1,
                 iid=(sample == "iid"),
-                iid_user_frac=(
-                    iid_users / max(1, len(data["users"]))
-                    if iid_users
-                    else 0.01
-                ),
+                # pass the requested --iu count through exactly; the
+                # frac-and-back round trip truncates under float error
+                iid_num_users=iid_users if iid_users else None,
                 seed=sample_seed,
             )
             write_leaf_json(data, os.path.join(stage_dir, "sampled.json"))

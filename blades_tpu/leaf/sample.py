@@ -11,7 +11,18 @@ import random
 from blades_tpu.leaf.util import iid_divide, read_leaf_dir, write_leaf_json
 
 
-def sample_leaf(data, fraction: float, iid: bool, iid_user_frac: float = 0.01, seed: int = 0):
+def sample_leaf(
+    data,
+    fraction: float,
+    iid: bool,
+    iid_user_frac: float = 0.01,
+    seed: int = 0,
+    iid_num_users: int = None,
+):
+    """``iid_num_users`` passes the synthetic-user count through exactly;
+    ``iid_user_frac`` (kept for reference CLI parity) derives it from the
+    original population and can truncate under float error (e.g. 3/147
+    round-trips to 2 via ``int(frac * len)``)."""
     rng = random.Random(seed)
     tot = sum(data["num_samples"])
     budget = int(fraction * tot)
@@ -23,7 +34,10 @@ def sample_leaf(data, fraction: float, iid: bool, iid_user_frac: float = 0.01, s
         pairs = list(zip(raw_x, raw_y))
         rng.shuffle(pairs)
         pairs = pairs[:budget]
-        num_users = max(1, int(iid_user_frac * len(data["users"])))
+        if iid_num_users is not None:
+            num_users = max(1, int(iid_num_users))
+        else:
+            num_users = max(1, round(iid_user_frac * len(data["users"])))
         groups = iid_divide(pairs, num_users)
         users = [str(i) for i in range(num_users)]
         return {

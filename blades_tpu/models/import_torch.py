@@ -28,9 +28,13 @@ Key-structure mapping (torch name -> flax path):
 
 from __future__ import annotations
 
+import logging
+import math
 from typing import Any, Dict, Mapping
 
 import numpy as np
+
+logger = logging.getLogger("debug")
 
 
 def _np(t) -> np.ndarray:
@@ -38,14 +42,55 @@ def _np(t) -> np.ndarray:
     return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
 
 
+def resize_pos_embed(
+    posemb: np.ndarray, new_len: int, num_tokens: int = 0
+) -> np.ndarray:
+    """Bilinearly rescale a learned positional-embedding grid to a new token
+    count (reference ``resize_pos_embed``/``pe_check``,
+    ``cctnets/utils/helpers.py:10-36``: loading a checkpoint trained at a
+    different input resolution interpolates the square grid; the first
+    ``num_tokens`` class-token embeddings pass through untouched).
+
+    ``posemb``: ``[1, n_old, d]`` -> returns ``[1, new_len, d]``.
+    """
+    import jax
+
+    tok, grid = posemb[:, :num_tokens], posemb[0, num_tokens:]
+    gs_old = int(math.sqrt(grid.shape[0]))
+    gs_new = int(math.sqrt(new_len - num_tokens))
+    if gs_old * gs_old != grid.shape[0] or gs_new * gs_new != new_len - num_tokens:
+        raise ValueError(
+            f"positional-embedding lengths {grid.shape[0]} -> "
+            f"{new_len - num_tokens} are not square grids; cannot interpolate"
+        )
+    grid = grid.reshape(gs_old, gs_old, -1)
+    # half-pixel-centered bilinear resize == torch F.interpolate(bilinear,
+    # align_corners=False), the reference's mode (helpers.py:24)
+    grid = jax.image.resize(
+        grid, (gs_new, gs_new, grid.shape[-1]), method="bilinear"
+    )
+    grid = np.asarray(grid).reshape(1, gs_new * gs_new, -1)
+    return np.concatenate([tok, grid], axis=1)
+
+
 def torch_cct_to_flax(
-    state_dict: Mapping[str, Any], params_template: Dict[str, Any]
+    state_dict: Mapping[str, Any],
+    params_template: Dict[str, Any],
+    pe_resize: bool = True,
+    fc_tolerant: bool = True,
 ) -> Dict[str, Any]:
     """Convert a reference-CCT torch state_dict into our flax param tree.
 
     ``params_template``: a freshly initialized param tree of the matching
     variant (supplies structure; every leaf must be covered by the
     state_dict and vice versa, or a ``ValueError`` explains the mismatch).
+
+    Load-tolerance semantics mirror the reference's checkpoint loader
+    (``cctnets/cct.py:110-116``): ``pe_resize`` bilinearly interpolates a
+    positional embedding whose token count differs (``pe_check``);
+    ``fc_tolerant`` keeps the template's freshly initialized classifier head
+    when the checkpoint's class count differs (``fc_check``). Pass False to
+    get strict shape errors instead.
     """
     import jax
 
@@ -127,6 +172,40 @@ def torch_cct_to_flax(
             )
         else:
             raise ValueError(f"unrecognized state_dict key {key!r}")
+
+    # pe_check: interpolate a positional embedding trained at a different
+    # resolution instead of failing the strict shape check below
+    if pe_resize and out.get("positional_emb") is not None:
+        tmpl_pe = params_template["positional_emb"]
+        cur = out["positional_emb"]
+        if tuple(cur.shape) != tuple(tmpl_pe.shape):
+            num_tokens = 1 if "class_emb" in params_template else 0
+            out["positional_emb"] = resize_pos_embed(
+                cur, int(tmpl_pe.shape[1]), num_tokens
+            )
+            logger.info(
+                "resized positional embedding %s -> %s (pe_check)",
+                cur.shape, tuple(tmpl_pe.shape),
+            )
+
+    # fc_check: a class-count mismatch keeps the fresh head instead of failing
+    if fc_tolerant:
+        fc_name = "Dense_1" if has_pool else "Dense_0"
+        fc_node = out.get(fc_name)
+        tmpl_fc = params_template.get(fc_name)
+        if isinstance(fc_node, dict) and isinstance(tmpl_fc, dict):
+            for leaf in ("kernel", "bias"):
+                got, want = fc_node.get(leaf), tmpl_fc.get(leaf)
+                if (
+                    got is not None
+                    and want is not None
+                    and tuple(got.shape) != tuple(want.shape)
+                ):
+                    logger.warning(
+                        "Removing %s.%s, number of classes has changed.",
+                        fc_name, leaf,
+                    )
+                    fc_node[leaf] = np.asarray(want)
 
     # completeness + shape validation against the template
     import jax.numpy as jnp

@@ -1,0 +1,134 @@
+"""One process of a multi-process SPMD federated round (test/dry-run rig).
+
+This is the program every host of a real pod would run (reference cluster
+story: "deploy a Ray cluster", ``README.rst:146-149``; here: N identical
+processes joined by ``jax.distributed.initialize``). Each process:
+
+1. forces a virtual CPU backend with ``local_devices`` fake devices,
+2. joins the cluster through :func:`blades_tpu.parallel.distributed.initialize`
+   (the explicit coordinator path — the branch a real pod executes),
+3. builds the global (clients, model) mesh over ALL processes' devices,
+4. materializes ONLY its own clients' data (``host_client_slice``) and
+   assembles the global arrays via ``make_global_client_array``,
+5. runs one full sharded federated round (vmapped local SGD, IPM attack,
+   trimmed-mean aggregation, server step) and prints a ``DIST_RESULT`` JSON
+   line with round metrics for the parent to compare across processes.
+
+Run as::
+
+    python -m blades_tpu.parallel._dist_worker <process_id> <num_processes> \
+        <coordinator_port> [local_devices]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+
+def make_data(num_clients: int, local_steps: int, batch: int):
+    """Deterministic synthetic MNIST-shaped client data — every process
+    generates the same global arrays and slices out its own rows."""
+    rng = np.random.RandomState(42)
+    cx = rng.randn(num_clients, local_steps, batch, 28, 28, 1).astype(np.float32)
+    cy = rng.randint(0, 10, (num_clients, local_steps, batch)).astype(np.int32)
+    return cx, cy
+
+
+def run_round(plan, num_clients: int, cx, cy, num_byzantine: int):
+    """Build the production RoundEngine and execute one round."""
+    import jax
+
+    from blades_tpu.aggregators import get_aggregator
+    from blades_tpu.attackers import get_attack
+    from blades_tpu.core import ClientOptSpec, RoundEngine, ServerOptSpec
+    from blades_tpu.models.common import build_fns
+    from blades_tpu.models.mlp import MLP
+
+    spec = build_fns(MLP(num_classes=10), sample_shape=(28, 28, 1))
+    params = spec.init(jax.random.PRNGKey(0))
+    engine = RoundEngine(
+        spec.train_loss_fn,
+        spec.eval_logits_fn,
+        params,
+        num_clients=num_clients,
+        num_byzantine=num_byzantine,
+        attack=get_attack("ipm"),
+        aggregator=get_aggregator("trimmedmean", num_byzantine=num_byzantine),
+        client_opt=ClientOptSpec(),
+        server_opt=ServerOptSpec(),
+        num_classes=10,
+        plan=plan,
+    )
+    state = engine.init(params)
+    state, metrics = engine.run_round(
+        state, cx, cy, 0.1, 1.0, jax.random.PRNGKey(3)
+    )
+    jax.block_until_ready(state.params)
+    return metrics
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    pid, nproc, port = int(argv[0]), int(argv[1]), int(argv[2])
+    local_devices = int(argv[3]) if len(argv) > 3 else 4
+
+    from blades_tpu.utils.platform import force_virtual_cpu
+
+    force_virtual_cpu(local_devices)
+
+    from blades_tpu.parallel import distributed as dist
+
+    # the explicit-coordinator branch (parallel/distributed.py:56-61) that a
+    # real multi-host pod takes; must precede any backend-touching JAX call
+    dist.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+
+    import jax
+
+    from blades_tpu.parallel.mesh import make_plan
+    from blades_tpu.utils.xla_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    assert jax.process_count() == nproc, (
+        f"expected {nproc} processes, runtime sees {jax.process_count()}"
+    )
+    mesh = dist.make_global_mesh((jax.device_count(), 1))
+    plan = make_plan(mesh)
+
+    num_clients = 2 * jax.device_count()
+    local_steps, batch = 2, 4
+    lo, hi = dist.host_client_slice(num_clients, mesh)
+    cx_full, cy_full = make_data(num_clients, local_steps, batch)
+    # only this host's rows enter device memory
+    cx = dist.make_global_client_array(cx_full[lo:hi], num_clients, plan)
+    cy = dist.make_global_client_array(cy_full[lo:hi], num_clients, plan)
+
+    metrics = run_round(plan, num_clients, cx, cy, num_byzantine=num_clients // 4)
+    dist.sync_global_devices("round-done")
+
+    print(
+        "DIST_RESULT "
+        + json.dumps(
+            {
+                "process": jax.process_index(),
+                "num_processes": jax.process_count(),
+                "local_devices": jax.local_device_count(),
+                "global_devices": jax.device_count(),
+                "client_slice": [lo, hi],
+                "is_coordinator": dist.is_coordinator(),
+                "train_loss": float(metrics.train_loss),
+                "agg_norm": float(metrics.agg_norm),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -44,38 +44,68 @@ _IGNORED_KWARGS = ("num_actors", "num_trainers", "gpu_per_actor", "mode", "use_c
 
 
 class _CompositeAttack(Attack):
-    """Applies each registered custom attacker's omniscient hook to its own
-    rows of the update matrix (reference: per-client callbacks registered at
-    ``simulator.py:167-187`` and invoked at ``simulator.py:239-241``)."""
+    """Applies each registered custom attacker's hooks to its own rows:
+    omniscient hooks rewrite that client's rows of the update matrix, and
+    batch/grad hooks dispatch per client via ``lax.switch`` on a static
+    client->attack table — a mixed population (e.g. one labelflipping and one
+    signflipping attacker) runs each client's own transform, matching the
+    reference's per-object hook dispatch (``client.py:231-253``,
+    ``simulator.py:167-187``)."""
 
     def __init__(self, entries):
-        # entries: list of (client_index, ByzantineClient)
+        # entries: list of (client_index, ByzantineClient); attacks built
+        # once — they may carry construction-time hyperparameters
         self.entries = entries
-        attacks = [c.make_attack() for _, c in entries]
+        self._attacks = [c.make_attack() for _, c in entries]
         self.trains_dishonestly = any(
-            a is not None and a.trains_dishonestly for a in attacks
+            a is not None and a.trains_dishonestly for a in self._attacks
         )
+        # dishonest-attack dispatch table: branch 0 = identity; distinct
+        # dishonest Attack objects get branches 1..n; each registered client
+        # index maps to its attack's branch
+        self._branches = []
+        branch_of = {}
+        self._idx_to_branch = {}
+        for (idx, _), a in zip(entries, self._attacks):
+            if a is None or not a.trains_dishonestly:
+                continue
+            if id(a) not in branch_of:
+                self._branches.append(a)
+                branch_of[id(a)] = len(self._branches)
+            self._idx_to_branch[idx] = branch_of[id(a)]
 
     def init_state(self, num_clients, dim):
+        # also materialize the [K] branch table now that K is known
+        table = np.zeros(num_clients, np.int32)
+        for idx, b in self._idx_to_branch.items():
+            table[idx] = b
+        self._branch_table = jnp.asarray(table)
         return tuple(
-            (c.make_attack().init_state(num_clients, dim) if c.make_attack() else ())
-            for _, c in self.entries
+            (a.init_state(num_clients, dim) if a is not None else ())
+            for a in self._attacks
         )
 
-    def on_batch(self, x, y, is_byz, *, num_classes, key):
-        # batch-level hooks require a uniform attack across byzantine clients
-        for _, c in self.entries:
-            a = c.make_attack()
-            if a is not None and a.trains_dishonestly:
-                return a.on_batch(x, y, is_byz, num_classes=num_classes, key=key)
-        return x, y
+    def on_batch(self, x, y, is_byz, *, num_classes, key, client_idx=None):
+        if not self._branches or client_idx is None:
+            return x, y
+        branches = [lambda x_, y_: (x_, y_)] + [
+            (
+                lambda a: lambda x_, y_: a.on_batch(
+                    x_, y_, is_byz, num_classes=num_classes, key=key
+                )
+            )(a)
+            for a in self._branches
+        ]
+        return jax.lax.switch(self._branch_table[client_idx], branches, x, y)
 
-    def on_grads(self, grads, is_byz):
-        for _, c in self.entries:
-            a = c.make_attack()
-            if a is not None and a.trains_dishonestly:
-                return a.on_grads(grads, is_byz)
-        return grads
+    def on_grads(self, grads, is_byz, client_idx=None):
+        if not self._branches or client_idx is None:
+            return grads
+        branches = [lambda g: g] + [
+            (lambda a: lambda g: a.on_grads(g, is_byz))(a)
+            for a in self._branches
+        ]
+        return jax.lax.switch(self._branch_table[client_idx], branches, grads)
 
     def on_updates(self, updates, byz_mask, key, state=()):
         k = updates.shape[0]

@@ -63,3 +63,25 @@ def force_virtual_cpu(n_devices: int) -> None:
         jax.config.update("jax_platforms", "cpu")
     except RuntimeError:
         pass  # backend already up; caller's device-count checks take over
+
+
+def apply_env_platform() -> None:
+    """Make an explicit ``JAX_PLATFORMS`` env request binding.
+
+    The TPU plugin's sitecustomize rewrites ``jax_platforms`` to
+    ``"axon,cpu"`` at interpreter start even when the caller exported
+    ``JAX_PLATFORMS=cpu`` — so a CPU-requesting launcher (docs/build.py,
+    subprocess harnesses) would still try to initialize the (possibly dead)
+    TPU backend first and hang. Scripts that honor the env contract call
+    this at startup: if the environment requests a non-axon platform set,
+    re-apply it in-process, with the virtual device count taken from
+    ``XLA_FLAGS`` (default 1)."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and "axon" not in want.split(","):
+        import re
+
+        m = re.search(
+            r"xla_force_host_platform_device_count=(\d+)",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        force_virtual_cpu(int(m.group(1)) if m else 1)

@@ -33,6 +33,10 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from blades_tpu.utils.platform import apply_env_platform  # noqa: E402
+
+apply_env_platform()  # honor JAX_PLATFORMS=cpu launchers (docs/build.py)
+
 
 def build_dataset(data_root: str, num_clients: int, seed: int):
     from blades_tpu.datasets import MNIST, Synthetic
@@ -96,8 +100,9 @@ def run_one(aggregator: str, data_root: str, out_dir: str, rounds: int,
     return read_stats(log_path, type_filter="test"), ds_kind
 
 
-def plot(curves: dict, path: str) -> None:
-    """Accuracy-vs-round lines (2 series: legend + direct end labels)."""
+def plot(curves: dict, path: str, bands: dict = None) -> None:
+    """Accuracy-vs-round lines (seed-0 curve; min-max band across seeds
+    when multi-seed data is provided)."""
     import matplotlib
 
     matplotlib.use("Agg")
@@ -114,6 +119,13 @@ def plot(curves: dict, path: str) -> None:
         xs = [t["Round"] for t in tests]
         ys = [100.0 * t["top1"] for t in tests]
         ax.plot(xs, ys, lw=2, color=colors.get(agg, "#666"), label=agg)
+        if bands and agg in bands and len(bands[agg]) > 1:
+            per_round = list(zip(*[[100.0 * t["top1"] for t in run]
+                                   for run in bands[agg]]))
+            lo = [min(v) for v in per_round]
+            hi = [max(v) for v in per_round]
+            ax.fill_between(xs, lo, hi, color=colors.get(agg, "#666"),
+                            alpha=0.15, lw=0)
     # identity via the legend only: the three curves end within ~2 points
     # of each other, so direct end labels would collide
     ax.set_xlabel("Round")
@@ -139,6 +151,10 @@ def main() -> None:
         "--plot",
         default=os.path.join(REPO, "docs", "assets", "config1_convergence.png"),
     )
+    p.add_argument("--seeds", type=int, nargs="+", default=[1],
+                   help="run every config once per seed; reports mean±range "
+                        "so a 0.2-point defense-recovery claim is backed by "
+                        "spread, not a single draw")
     args = p.parse_args()
     os.makedirs(args.out, exist_ok=True)
 
@@ -147,23 +163,41 @@ def main() -> None:
         ("mean+alie", "mean", "alie", "mean_alie"),
         ("trimmedmean+alie", "trimmedmean", "alie", "trimmedmean_alie"),
     ]
-    curves, kind = {}, None
+    curves, bands, kind = {}, {}, None
+    finals = {}
     for label, agg, attack, tag in runs:
-        tests, kind = run_one(agg, args.data_root, args.out, args.rounds,
-                              attack=attack, tag=tag)
-        curves[label] = tests
-        print(f"{label}: final top1 = {tests[-1]['top1']:.4f}")
+        bands[label] = []
+        finals[label] = {}
+        for seed in args.seeds:
+            stag = tag if seed == args.seeds[0] else f"{tag}_s{seed}"
+            tests, kind = run_one(agg, args.data_root, args.out, args.rounds,
+                                  seed=seed, attack=attack, tag=stag)
+            bands[label].append(tests)
+            finals[label][seed] = tests[-1]["top1"]
+            print(f"{label} seed {seed}: final top1 = {tests[-1]['top1']:.4f}")
+        curves[label] = bands[label][0]
+
+    def stats(vals):
+        vals = list(vals)
+        return {
+            "mean": sum(vals) / len(vals),
+            "min": min(vals),
+            "max": max(vals),
+            "n_seeds": len(vals),
+        }
 
     summary = {
         "config": "BASELINE config 1 (mini_example): MLP, 10 clients, "
                   "4xALIE, 100 rounds x 50 local steps",
         "dataset": kind,
-        "final_top1": {a: curves[a][-1]["top1"] for a in curves},
+        "seeds": args.seeds,
+        "final_top1": {a: stats(finals[a].values()) for a in finals},
+        "final_top1_per_seed": finals,
         "final_loss": {a: curves[a][-1]["Loss"] for a in curves},
     }
     with open(os.path.join(args.out, "summary.json"), "w") as f:
         json.dump(summary, f, indent=2)
-    plot(curves, args.plot)
+    plot(curves, args.plot, bands=bands)
     print(json.dumps(summary, indent=2))
 
 

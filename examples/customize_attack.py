@@ -17,6 +17,10 @@ functions* that run inside the compiled round program — subclass
 
 import sys
 
+from blades_tpu.utils.platform import apply_env_platform
+
+apply_env_platform()  # honor JAX_PLATFORMS=cpu launchers (docs/build.py)
+
 import jax.numpy as jnp
 
 from blades_tpu.attackers.base import Attack, honest_stats

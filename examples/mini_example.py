@@ -14,6 +14,10 @@ Run with real MNIST under ``./data`` (IDX files or mnist.npz), or pass
 import os
 import sys
 
+from blades_tpu.utils.platform import apply_env_platform
+
+apply_env_platform()  # honor JAX_PLATFORMS=cpu launchers (docs/build.py)
+
 from blades_tpu.datasets import MNIST, Synthetic
 from blades_tpu.simulator import Simulator
 

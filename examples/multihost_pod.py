@@ -15,6 +15,10 @@ Works unchanged on a single host — the distributed init is a no-op there.
 
 import os
 
+from blades_tpu.utils.platform import apply_env_platform
+
+apply_env_platform()  # honor JAX_PLATFORMS=cpu launchers (docs/build.py)
+
 import jax
 import numpy as np
 

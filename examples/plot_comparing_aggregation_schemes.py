@@ -12,6 +12,10 @@ import os
 
 import numpy as np
 
+from blades_tpu.utils.platform import apply_env_platform
+
+apply_env_platform()  # honor JAX_PLATFORMS=cpu launchers (docs/build.py)
+
 import jax.numpy as jnp
 
 from blades_tpu.aggregators import AGGREGATORS, get_aggregator

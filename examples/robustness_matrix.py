@@ -23,6 +23,10 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from blades_tpu.utils.platform import apply_env_platform  # noqa: E402
+
+apply_env_platform()  # honor JAX_PLATFORMS=cpu launchers (docs/build.py)
+
 ATTACKS = ["none", "noise", "labelflipping", "signflipping", "alie", "ipm"]
 AGGS = ["mean", "median", "trimmedmean", "geomed", "krum",
         "clippedclustering", "dnc", "signguard"]
@@ -32,6 +36,88 @@ K, BYZ = 20, 8
 # defenses that take the attacker-budget assumption as a constructor arg;
 # the defender's assumed f is held at the true BYZ for every cell
 BUDGET_AGGS = {"trimmedmean", "krum", "dnc"}
+
+# Per-cell expectations, checked by tests/test_matrix_summary.py — the matrix
+# is a regression GATE, not just logs + a PNG. Bounds carry ~0.1 margin vs
+# the committed 20-round seed-1 measurements to tolerate seed noise while
+# still catching a defense that silently stops working (or an attack that
+# silently stops biting). Notable rows: sign-symmetric defenses (median /
+# trimmedmean / signguard) break under signflipping; Krum-family and
+# distance-based defenses (median/trimmedmean/geomed/krum) collapse under
+# IPM because the 8 byzantine rows are IDENTICAL (-eps * honest mean), give
+# each other zero pairwise distance, and win every nearest-neighbor
+# selection — DnC and clipped clustering are the only defenses that hold
+# every row.
+#   rule: ("min", x) = defense holds, top1 >= x
+#         ("max", x) = attack wins,   top1 <= x
+#         ("range", lo, hi) = degraded but not destroyed
+EXPECTATIONS = {
+    "none": {agg: ("min", 0.50) for agg in AGGS},
+    "noise": {
+        "mean": ("max", 0.30),
+        **{a: ("min", 0.55) for a in
+           ("median", "trimmedmean", "geomed", "krum", "clippedclustering",
+            "dnc", "signguard")},
+    },
+    "labelflipping": {
+        "mean": ("range", 0.25, 0.55),
+        "median": ("range", 0.25, 0.55),
+        "trimmedmean": ("range", 0.25, 0.55),
+        "geomed": ("min", 0.50),
+        "krum": ("min", 0.50),
+        "clippedclustering": ("min", 0.50),
+        "dnc": ("min", 0.65),
+        "signguard": ("range", 0.35, 0.70),
+    },
+    "signflipping": {
+        "mean": ("max", 0.30),
+        "median": ("max", 0.30),
+        "trimmedmean": ("max", 0.30),
+        "signguard": ("max", 0.30),
+        "geomed": ("min", 0.50),
+        "krum": ("min", 0.50),
+        "clippedclustering": ("min", 0.50),
+        "dnc": ("min", 0.65),
+    },
+    "alie": {
+        **{a: ("min", 0.50) for a in AGGS},
+        "dnc": ("min", 0.65),
+    },
+    "ipm": {
+        "mean": ("range", 0.10, 0.50),
+        "median": ("max", 0.20),
+        "trimmedmean": ("max", 0.20),
+        "geomed": ("max", 0.20),
+        "krum": ("max", 0.20),
+        "signguard": ("range", 0.25, 0.60),
+        "clippedclustering": ("min", 0.50),
+        "dnc": ("min", 0.65),
+    },
+}
+
+
+def evaluate_expectations(matrix):
+    """Check every expectation against a measured matrix; returns (rows,
+    all_ok) where rows carry per-cell verdicts for summary.json."""
+    rows = []
+    ok_all = True
+    for attack, cells in EXPECTATIONS.items():
+        for agg, rule in cells.items():
+            value = matrix.get(attack, {}).get(agg)
+            if value is None:
+                ok = False
+            elif rule[0] == "min":
+                ok = value >= rule[1]
+            elif rule[0] == "max":
+                ok = value <= rule[1]
+            else:
+                ok = rule[1] <= value <= rule[2]
+            ok_all = ok_all and ok
+            rows.append(
+                {"attack": attack, "agg": agg, "rule": list(rule),
+                 "top1": value, "ok": bool(ok)}
+            )
+    return rows, ok_all
 
 
 def run_cell(ds, attack: str, agg: str, rounds: int, out_dir: str) -> float:
@@ -128,6 +214,17 @@ def main() -> None:
     if all(agg in matrix.get(a, {}) for a in ATTACKS for agg in AGGS):
         plot(matrix, os.path.join(args.out, "matrix.png"))
         print("plot:", os.path.join(args.out, "matrix.png"))
+        rows, ok = evaluate_expectations(matrix)
+        with open(os.path.join(args.out, "summary.json"), "w") as f:
+            json.dump(
+                {"rounds": matrix["_rounds"], "all_ok": ok, "cells": rows},
+                f, indent=1,
+            )
+        bad = [r for r in rows if not r["ok"]]
+        print(f"expectations: {len(rows) - len(bad)}/{len(rows)} ok")
+        for r in bad:
+            print(f"  FAIL {r['attack']} x {r['agg']}: top1={r['top1']} "
+                  f"rule={r['rule']}")
 
 
 if __name__ == "__main__":

@@ -29,6 +29,10 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from blades_tpu.utils.platform import apply_env_platform  # noqa: E402
+
+apply_env_platform()  # honor JAX_PLATFORMS=cpu launchers (docs/build.py)
+
 # reference sweep table ("Simulation on MNIST.py" lines 49-55)
 AGGS = {
     "mean": {},

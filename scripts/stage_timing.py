@@ -1,0 +1,111 @@
+"""Stage-level timing of the K=1000 headline round on the real chip.
+
+Separates where the round's time goes, with a device sync after each stage:
+(a) the jitted device-side sampler alone, (b) the full round program,
+(c) trimmed-mean aggregation alone on a [K, D] matrix, (d) a plain mean
+reduction (lower bound for any aggregator). Feeds the cost accounting in
+docs/performance.md. Prints one ``STAGES {json}`` line.
+
+Reference counterpart: the reference logs only whole-round wall time
+(src/blades/simulator.py:453-455); it has no stage breakdown to compare
+against, so these numbers only inform our own optimization.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blades_tpu.utils.xla_cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+from blades_tpu.aggregators import get_aggregator
+from blades_tpu.core import ClientOptSpec, RoundEngine, ServerOptSpec
+from blades_tpu.datasets.augment import make_normalizer
+from blades_tpu.datasets.cifar10 import CIFAR10_MEAN, CIFAR10_STD
+from blades_tpu.datasets.fl import FLDataset
+from blades_tpu.models import cct_2_3x2_32
+from blades_tpu.models.common import build_fns
+from blades_tpu.ops.pallas_trimmed import trimmed_mean
+
+K = int(os.environ.get("STAGE_CLIENTS", 1000))
+S, B = 1, 32
+CHUNKS = int(os.environ.get("STAGE_CHUNKS", 4))
+
+rng = np.random.RandomState(0)
+train_x = rng.randint(0, 256, (K, 50, 32, 32, 3), dtype=np.uint8)
+train_y = rng.randint(0, 10, (K, 50)).astype(np.int32)
+counts = np.full(K, 50, np.int32)
+ds = FLDataset(
+    train_x, train_y, counts, train_x[0], train_y[0],
+    normalize=make_normalizer(CIFAR10_MEAN, CIFAR10_STD),
+)
+
+spec = build_fns(
+    cct_2_3x2_32(num_classes=10), sample_shape=(32, 32, 3),
+    compute_dtype=jnp.bfloat16,
+)
+params = spec.init(jax.random.PRNGKey(0))
+D = sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+engine = RoundEngine(
+    spec.train_loss_fn, spec.eval_logits_fn, params,
+    num_clients=K, num_byzantine=0,
+    aggregator=get_aggregator("trimmedmean"),
+    client_opt=ClientOptSpec(), server_opt=ServerOptSpec(),
+    num_classes=10, plan=None, client_chunks=CHUNKS, remat=True,
+)
+key = jax.random.PRNGKey(7)
+
+res = {"D": int(D), "K": K, "chunks": CHUNKS,
+       "platform": jax.devices()[0].platform}
+
+
+def report(name, value):
+    res[name] = value
+    print(f"STAGE {name} = {value}", flush=True)
+
+
+def timeit(f, n=10):
+    out = f()  # warm (compile)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(n):
+        out = f()
+    jax.block_until_ready(out)
+    return (time.time() - t0) / n
+
+
+# (a) device-side sampler alone
+report("sampler_s", timeit(lambda: ds.sample_round(key, S, B)))
+
+# (b) full round program; run_round donates its input state, so thread the
+# returned state back instead of reusing a consumed buffer
+cx, cy = ds.sample_round(key, S, B)
+jax.block_until_ready(cy)
+state_box = [engine.init(params)]
+
+
+def full_round():
+    st, _ = engine.run_round(state_box[0], cx, cy, 0.1, 1.0, key)
+    state_box[0] = st
+    return st.params
+
+
+report("full_round_s", timeit(full_round))
+
+# (c)/(d) aggregation alone on a [K, D] update matrix
+u = jax.random.normal(jax.random.PRNGKey(1), (K, D), jnp.float32)
+jax.block_until_ready(u)
+sortpath = jax.jit(lambda m: trimmed_mean(m, 5))
+report("trimmedmean_sort_s", timeit(lambda: sortpath(u)))
+meanpath = jax.jit(lambda m: jnp.mean(m, axis=0))
+report("mean_reduce_s", timeit(lambda: meanpath(u)))
+
+print("STAGES " + json.dumps(res), flush=True)

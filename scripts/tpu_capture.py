@@ -1,0 +1,147 @@
+"""Round-4 TPU evidence capture: run everything VERDICT asked for in one
+tunnel-up window, most valuable first (the tunnel dies without warning).
+
+Captures, in order:
+  1. headline bench (parent ladder, official JSON) -> results/tpu_r4/headline.json
+     and refreshes results/bench_tpu.json (the prior-capture carry)
+  2. jax.profiler trace of the headline round  -> results/tpu_r4/profile/
+  3. BASELINE.md configs 2-5 rows              -> results/tpu_r4/rows.jsonl
+  4. stage timings for the MFU accounting      -> results/tpu_r4/stages.json
+
+Each measurement is a fresh subprocess with a timeout: TPU "Unavailable"
+errors poison the owning process, and one dead row must not kill the rest.
+Run via scripts/tpu_watch.sh, which polls for an up-window first.
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "results", "tpu_r4")
+os.makedirs(OUT, exist_ok=True)
+ROWS = os.path.join(OUT, "rows.jsonl")
+
+
+def log(msg):
+    print(f"[capture {datetime.datetime.utcnow():%H:%M:%S}] {msg}", flush=True)
+
+
+def run(cmd, timeout, env=None):
+    full_env = dict(os.environ)
+    if env:
+        full_env.update({k: str(v) for k, v in env.items()})
+    try:
+        p = subprocess.run(
+            cmd, cwd=REPO, env=full_env, capture_output=True, text=True,
+            timeout=timeout,
+        )
+        return p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired:
+        return None, "", f"timeout after {timeout}s"
+
+
+def child_row(name, timeout=1500, **env):
+    """One bench.py child under BENCH_CHILD=1; append its result to rows.jsonl."""
+    log(f"row {name}: {env}")
+    rc, out, err = run([sys.executable, "bench.py"], timeout,
+                       env={"BENCH_CHILD": 1, **env})
+    row = {"name": name, "env": {k: str(v) for k, v in env.items()}}
+    for line in out.splitlines():
+        if line.startswith("BENCH_CHILD_RESULT "):
+            row.update(json.loads(line[len("BENCH_CHILD_RESULT "):]))
+    if "rounds_per_sec" not in row and "error" not in row:
+        row["error"] = (err or "no result line")[-300:]
+    row["date"] = datetime.datetime.utcnow().isoformat()
+    with open(ROWS, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    log(f"row {name}: {row.get('rounds_per_sec', row.get('error'))}")
+    return row
+
+
+def main():
+    # --- 1. headline through the official parent ladder -------------------
+    log("headline bench")
+    rc, out, err = run([sys.executable, "bench.py"], 2400)
+    line = out.strip().splitlines()[-1] if out.strip() else ""
+    try:
+        headline = json.loads(line)
+    except Exception:
+        headline = {"error": (err or out)[-300:]}
+    headline["date"] = datetime.datetime.utcnow().isoformat()
+    with open(os.path.join(OUT, "headline.json"), "w") as f:
+        json.dump(headline, f, indent=1)
+    log(f"headline: {headline}")
+    if headline.get("value") and headline.get("platform") not in (None, "cpu"):
+        with open(os.path.join(REPO, "results", "bench_tpu.json"), "w") as f:
+            json.dump(headline, f, indent=1)
+
+    # --- 2. profiler trace of the headline config -------------------------
+    child_row(
+        "headline_trace", timeout=1800,
+        BENCH_PROFILE_DIR=os.path.join(OUT, "profile"),
+        BENCH_WARMUP=2, BENCH_TIMED=3,
+    )
+
+    # --- 3. BASELINE.md configs 2-5 ---------------------------------------
+    # config 2: ResNet-18, 100 clients, fedsgd, no attack + mean
+    child_row("config2_resnet18_k100_mean", BENCH_MODEL="resnet18",
+              BENCH_CLIENTS=100, BENCH_CHUNKS=10, BENCH_AGG="mean",
+              BENCH_WARMUP=2, BENCH_TIMED=5)
+    # config 3: ResNet-18, 100 clients, fedavg (5 local steps, client Adam),
+    # IPM + Krum, 20% byzantine
+    child_row("config3_resnet18_k100_fedavg_ipm_krum", BENCH_MODEL="resnet18",
+              BENCH_CLIENTS=100, BENCH_CHUNKS=10, BENCH_AGG="krum",
+              BENCH_ATTACK="ipm", BENCH_NUM_BYZ=20, BENCH_CLIENT_OPT="adam",
+              BENCH_LOCAL_STEPS=5, BENCH_WARMUP=2, BENCH_TIMED=5)
+    # config 4: ResNet-18, fedsgd, signflipping + median / geomed. K=1000
+    # needs a 44 GB [K,D] fp32 matrix -- HBM-infeasible on one v5e chip
+    # (16 GB); ladder down to find the single-chip bound.
+    for k in (300, 200, 100):
+        r = child_row(f"config4_resnet18_k{k}_signflip_median",
+                      BENCH_MODEL="resnet18", BENCH_CLIENTS=k,
+                      BENCH_CHUNKS=max(1, k // 10), BENCH_AGG="median",
+                      BENCH_ATTACK="signflipping", BENCH_NUM_BYZ=k // 5,
+                      BENCH_WARMUP=2, BENCH_TIMED=5)
+        if "rounds_per_sec" in r:
+            child_row(f"config4_resnet18_k{k}_signflip_geomed",
+                      BENCH_MODEL="resnet18", BENCH_CLIENTS=k,
+                      BENCH_CHUNKS=max(1, k // 10), BENCH_AGG="geomed",
+                      BENCH_ATTACK="signflipping", BENCH_NUM_BYZ=k // 5,
+                      BENCH_WARMUP=2, BENCH_TIMED=5)
+            break
+    # config 5: WRN-28-10 (D~36M), CIFAR-100 shapes, fedavg, labelflipping
+    # + dnc / clippedclustering; K ladder for the same HBM reason.
+    for k in (50, 20):
+        r = child_row(f"config5_wrn_k{k}_labelflip_clippedclustering",
+                      BENCH_MODEL="wrn_28_10", BENCH_NUM_CLASSES=100,
+                      BENCH_CLIENTS=k, BENCH_CHUNKS=max(1, k // 5),
+                      BENCH_AGG="clippedclustering",
+                      BENCH_ATTACK="labelflipping", BENCH_NUM_BYZ=k // 5,
+                      BENCH_CLIENT_OPT="adam", BENCH_LOCAL_STEPS=5,
+                      BENCH_WARMUP=1, BENCH_TIMED=3)
+        if "rounds_per_sec" in r:
+            child_row(f"config5_wrn_k{k}_labelflip_dnc",
+                      BENCH_MODEL="wrn_28_10", BENCH_NUM_CLASSES=100,
+                      BENCH_CLIENTS=k, BENCH_CHUNKS=max(1, k // 5),
+                      BENCH_AGG="dnc", BENCH_ATTACK="labelflipping",
+                      BENCH_NUM_BYZ=k // 5, BENCH_CLIENT_OPT="adam",
+                      BENCH_LOCAL_STEPS=5, BENCH_WARMUP=1, BENCH_TIMED=3)
+            break
+
+    # --- 4. stage timings --------------------------------------------------
+    log("stage timings")
+    rc, out, err = run([sys.executable, "scripts/stage_timing.py"], 1800)
+    stages = None
+    for line in out.splitlines():
+        if line.startswith("STAGES "):
+            stages = json.loads(line[len("STAGES "):])
+    with open(os.path.join(OUT, "stages.json"), "w") as f:
+        json.dump(stages or {"error": (err or out)[-300:]}, f, indent=1)
+    log(f"stages: {stages}")
+    log("capture complete")
+
+
+if __name__ == "__main__":
+    main()

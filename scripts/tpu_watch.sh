@@ -1,0 +1,17 @@
+#!/bin/bash
+# Poll the TPU tunnel; on the first up-window, run the full round-4 evidence
+# capture (scripts/tpu_capture.py). The tunnel dies for hours at a time, so
+# this runs in a tmux session from the start of the round.
+cd /root/repo
+for i in $(seq 1 130); do
+  if timeout 120 python -c "import jax; jax.jit(lambda x: x+1)(jax.numpy.zeros(4)).block_until_ready(); print('ALIVE', jax.devices()[0].platform)" 2>/dev/null | grep -q "ALIVE tpu"; then
+    echo "TPU ALIVE at $(date -u), capturing..."
+    python scripts/tpu_capture.py 2>&1 | tee /tmp/tpu_capture.log
+    echo "WATCH DONE at $(date -u)"
+    exit 0
+  fi
+  echo "probe $i: tpu down at $(date -u)"
+  sleep 300
+done
+echo "gave up after 130 probes"
+exit 1

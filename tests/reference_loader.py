@@ -1,0 +1,86 @@
+"""Load the reference implementation standalone for differential testing.
+
+The reference stack (torch + scipy + sklearn) is fully installed in this
+image; the only missing dependency is ``ray``, imported solely at
+``src/blades/client.py:6`` for trainer-mode ``train.torch.prepare_model``.
+We install a minimal fake ``ray.train`` and set ``blades.__path__`` to the
+reference source tree, so every other reference module — the real
+``BladesClient``/``ByzantineClient``, all aggregators, all attacker clients —
+loads and runs verbatim. Differential tests then feed identical inputs to the
+reference's actual code and to blades_tpu.
+
+Environment shim (behavior-preserving): sklearn >= 1.4 removed the
+``affinity=`` kwarg of ``AgglomerativeClustering`` (renamed ``metric=`` in
+1.2); the reference (``aggregators/clustering.py:39``,
+``clippedclustering.py:60``) passes ``affinity='precomputed'``. The shim maps
+the kwarg name only.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+import types
+
+REF_SRC = "/root/reference/src"
+
+
+class _AggloCompat:
+    """sklearn AgglomerativeClustering with the pre-1.4 ``affinity=`` kwarg."""
+
+    def __init__(self, *args, affinity=None, **kwargs):
+        from sklearn.cluster import AgglomerativeClustering
+
+        if affinity is not None:
+            kwargs["metric"] = affinity
+        self._inner = AgglomerativeClustering(*args, **kwargs)
+
+    def fit(self, X):
+        self._inner.fit(X)
+        self.labels_ = self._inner.labels_
+        return self
+
+
+def load_reference():
+    """Import the reference ``blades`` package from /root/reference/src.
+
+    Returns the ``blades`` namespace module with ``client``, ``aggregators``
+    (incl. unexported ``fltrust``/``byzantinesgd``) and ``attackers.*client``
+    submodules loaded.
+    """
+    existing = sys.modules.get("blades")
+    if existing is not None and getattr(existing, "__ref_loaded__", False):
+        return existing
+
+    # torch >= 1.13 removed torch._six; the reference's torch_utils.py:7
+    # only takes ``inf`` from it
+    if "torch._six" not in sys.modules:
+        six = types.ModuleType("torch._six")
+        six.inf = float("inf")
+        sys.modules["torch._six"] = six
+
+    ray = types.ModuleType("ray")
+    ray_train = types.ModuleType("ray.train")
+    ray_train.torch = types.SimpleNamespace(prepare_model=lambda m, **k: m)
+    ray.train = ray_train
+    sys.modules["ray"] = ray
+    sys.modules["ray.train"] = ray_train
+
+    blades = types.ModuleType("blades")
+    blades.__path__ = [REF_SRC + "/blades"]
+    blades.__ref_loaded__ = True
+    sys.modules["blades"] = blades
+
+    blades.client = importlib.import_module("blades.client")
+    blades.aggregators = importlib.import_module("blades.aggregators")
+    # not re-exported by the reference __init__ — load explicitly
+    importlib.import_module("blades.aggregators.centeredclipping")
+    importlib.import_module("blades.aggregators.fltrust")
+    importlib.import_module("blades.aggregators.byzantinesgd")
+    blades.aggregators.clustering.AgglomerativeClustering = _AggloCompat
+    blades.aggregators.clippedclustering.AgglomerativeClustering = _AggloCompat
+
+    blades.attackers = importlib.import_module("blades.attackers")
+    for name in ("alie", "ipm", "noise", "labelflipping", "signflipping"):
+        importlib.import_module(f"blades.attackers.{name}client")
+    return blades

@@ -80,6 +80,94 @@ def test_vit_lite_forward_parity_with_reference():
     np.testing.assert_allclose(ours, ref, atol=5e-3, rtol=1e-2)
 
 
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_pe_resize_matches_reference_pe_check():
+    """Loading a checkpoint trained at a different input resolution must
+    interpolate the positional-embedding grid exactly like the reference's
+    ``pe_check`` (cctnets/utils/helpers.py:10-36)."""
+    import sys
+
+    sys.path.insert(0, REF)
+    from blades.models.cifar10.cctnets.cct import cct_2_3x2_32 as torch_cct
+    from blades.models.cifar10.cctnets.utils.helpers import pe_check
+
+    tm24 = torch_cct(pretrained=False, progress=False, num_classes=10, img_size=24)
+    sd = tm24.state_dict()
+    spec32 = build_fns(cct_2_3x2_32(num_classes=10), (32, 32, 3))
+    template = spec32.init(jax.random.PRNGKey(0))
+
+    # strict mode: shape mismatch must be an error
+    with pytest.raises(ValueError, match="shape mismatch|positional"):
+        torch_cct_to_flax(sd, template, pe_resize=False)
+
+    params = torch_cct_to_flax(sd, template)  # pe_resize on by default
+
+    tm32 = torch_cct(pretrained=False, progress=False, num_classes=10, img_size=32)
+    sd_ref = {k: v.clone() for k, v in tm24.state_dict().items()}
+    sd_ref = pe_check(tm32, sd_ref)
+    np.testing.assert_allclose(
+        np.asarray(params["positional_emb"]),
+        sd_ref["classifier.positional_emb"].detach().numpy(),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_pe_resize_class_token_variant_matches_reference():
+    """num_tokens=1 path: the class-token embedding passes through untouched
+    while the grid is interpolated (helpers.py:16-18)."""
+    import sys
+
+    sys.path.insert(0, REF)
+    import torch
+
+    from blades.models.cifar10.cctnets.utils.helpers import resize_pos_embed
+
+    from blades_tpu.models.import_torch import resize_pos_embed as ours
+
+    rng = np.random.RandomState(0)
+    pe = rng.randn(1, 1 + 49, 8).astype(np.float32)
+    new = torch.zeros(1, 1 + 81, 8)
+    theirs = resize_pos_embed(torch.from_numpy(pe.copy()), new, num_tokens=1)
+    mine = ours(pe, 1 + 81, num_tokens=1)
+    np.testing.assert_allclose(mine, theirs.numpy(), rtol=1e-4, atol=1e-5)
+    # class token untouched
+    np.testing.assert_array_equal(mine[:, 0], pe[:, 0])
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_fc_mismatch_keeps_fresh_head():
+    """A checkpoint with a different class count keeps the template's fresh
+    classifier head (reference ``fc_check``, helpers.py:39-45) while every
+    other layer loads from the checkpoint."""
+    import sys
+
+    sys.path.insert(0, REF)
+    from blades.models.cifar10.cctnets.cct import cct_2_3x2_32 as torch_cct
+
+    tm100 = torch_cct(pretrained=False, progress=False, num_classes=100, img_size=32)
+    sd = tm100.state_dict()
+    spec10 = build_fns(cct_2_3x2_32(num_classes=10), (32, 32, 3))
+    template = spec10.init(jax.random.PRNGKey(0))
+
+    with pytest.raises(ValueError, match="shape mismatch"):
+        torch_cct_to_flax(sd, template, fc_tolerant=False)
+
+    params = torch_cct_to_flax(sd, template)
+    fc_name = "Dense_1" if "Dense_1" in template else "Dense_0"
+    # head: fresh init from the template
+    np.testing.assert_array_equal(
+        np.asarray(params[fc_name]["kernel"]), np.asarray(template[fc_name]["kernel"])
+    )
+    # everything else: from the checkpoint (spot-check the first tokenizer conv)
+    np.testing.assert_allclose(
+        np.asarray(params["Tokenizer_0"]["Conv_0"]["kernel"]),
+        sd["tokenizer.conv_layers.0.0.weight"].detach().numpy().transpose(2, 3, 1, 0),
+        rtol=1e-6,
+    )
+
+
 def test_variant_mismatch_raises_value_error():
     """Wrong-depth checkpoints and non-CCT keys fail with ValueError."""
     spec = build_fns(cct_2_3x2_32(num_classes=10), (32, 32, 3))

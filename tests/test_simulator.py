@@ -94,6 +94,48 @@ def test_custom_attacker_registration(tmp_path):
     assert np.allclose(np.asarray(sim.get_clients()[0].get_update()), 0.0)
 
 
+def test_mixed_custom_attackers_dispatch_per_client(tmp_path):
+    """A labelflipping and a signflipping attacker registered TOGETHER each
+    get their own in-graph batch/grad hook (reference runs each client
+    object's own hooks, client.py:231-253). Row 0 must match a uniform
+    labelflipping run, row 1 must be the exact negation of its honest
+    counterpart (signflipping at local_steps=1), rows 2+ untouched."""
+    from blades_tpu.attackers import get_attack
+
+    class LFClient(ByzantineClient):
+        def make_attack(self):
+            return get_attack("labelflipping", num_classes=2)
+
+    class SFClient(ByzantineClient):
+        def make_attack(self):
+            return get_attack("signflipping")
+
+    run_kw = dict(global_rounds=1, local_steps=1, train_batch_size=8,
+                  validate_interval=1, retain_updates=True)
+
+    sim_h = _sim(tmp_path / "h", seed=5)
+    sim_h.run("mlp", **run_kw)
+    u_honest = np.asarray(sim_h.engine.last_updates)
+
+    sim_l = _sim(tmp_path / "l", seed=5, num_byzantine=1, attack="labelflipping")
+    sim_l.run("mlp", **run_kw)
+    u_uniform_lf = np.asarray(sim_l.engine.last_updates)
+
+    sim_m = _sim(tmp_path / "m", seed=5)
+    lf, sf = LFClient(), SFClient()
+    sim_m.register_attackers([lf, sf])
+    sim_m.run("mlp", **run_kw)
+    u_mixed = np.asarray(sim_m.engine.last_updates)
+
+    # row 0: labelflipping, identical to the uniform-labelflipping row
+    np.testing.assert_allclose(u_mixed[0], u_uniform_lf[0], rtol=1e-5, atol=1e-7)
+    assert not np.allclose(u_mixed[0], u_honest[0])
+    # row 1: signflipping = exact negation of the honest update at 1 step
+    np.testing.assert_allclose(u_mixed[1], -u_honest[1], rtol=1e-5, atol=1e-7)
+    # rows 2+: honest, bit-identical data path
+    np.testing.assert_allclose(u_mixed[2:], u_honest[2:], rtol=1e-6, atol=1e-8)
+
+
 def test_trusted_clients_flow_to_fltrust(tmp_path):
     sim = _sim(tmp_path, aggregator="fltrust")
     sim.set_trusted_clients([0])
