@@ -112,6 +112,9 @@ def child_main() -> None:
     client_opt_name = os.environ.get("BENCH_CLIENT_OPT", "sgd")
     num_classes = int(os.environ.get("BENCH_NUM_CLASSES", 10))
     profile_dir = os.environ.get("BENCH_PROFILE_DIR") or None
+    # remat trades a second forward pass for activation HBM; on by default
+    # (the K=1000 headline needs it), off to measure its cost at smaller K
+    remat = os.environ.get("BENCH_REMAT", "1") != "0"
     # bf16 forward/backward on the MXU (master weights fp32); set
     # BENCH_BF16=0 to benchmark the pure-fp32 path
     bf16 = os.environ.get("BENCH_BF16", "1") != "0"
@@ -199,7 +202,7 @@ def child_main() -> None:
             num_classes=num_classes,
             plan=plan,
             client_chunks=chunks,
-            remat=True,
+            remat=remat,
         )
         state = engine.init(params)
         key = jax.random.PRNGKey(7)

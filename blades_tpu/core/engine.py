@@ -247,13 +247,18 @@ class RoundEngine:
             bkey = jax.random.fold_in(ckey, i)
             # client_idx lets per-client composites dispatch; user attacks
             # written against the original hook signature (no client_idx)
-            # keep working via the TypeError fallback (trace-time only)
+            # keep working via the fallback — which triggers ONLY on the
+            # signature mismatch itself, so a genuine trace-time TypeError
+            # inside a hook still surfaces instead of silently disabling
+            # the attack
             try:
                 x, y = self.attack.on_batch(
                     x, y, is_byz, num_classes=self.num_classes, key=bkey,
                     client_idx=idx,
                 )
-            except TypeError:
+            except TypeError as e:
+                if "client_idx" not in str(e):
+                    raise
                 x, y = self.attack.on_batch(
                     x, y, is_byz, num_classes=self.num_classes, key=bkey
                 )
@@ -270,7 +275,9 @@ class RoundEngine:
             (loss, aux), grads = jax.value_and_grad(clamped_loss, has_aux=True)(p)
             try:
                 grads = self.attack.on_grads(grads, is_byz, client_idx=idx)
-            except TypeError:
+            except TypeError as e:
+                if "client_idx" not in str(e):
+                    raise
                 grads = self.attack.on_grads(grads, is_byz)
             updates, ost = self._client_tx.update(grads, ost, p)
             p = jax.tree_util.tree_map(

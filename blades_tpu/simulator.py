@@ -282,6 +282,7 @@ class Simulator:
         client_chunks: int = 1,
         remat: bool = False,
         compute_dtype: Optional[str] = None,
+        on_round_end: Optional[Callable] = None,
     ) -> List[float]:
         """Run adversarial training; returns per-round wall times (reference
         ``run`` contract, ``simulator.py:364-457``).
@@ -369,6 +370,10 @@ class Simulator:
                 # populate reference-parity client.get_update() views
                 for i, c in enumerate(self.get_clients()):
                     c.save_update(self.engine.last_updates[i])
+            if on_round_end is not None:
+                # observability hook: (round, state, metrics); the round's
+                # post-attack update matrix is engine.last_updates
+                on_round_end(rnd, state, m)
 
             if rnd % validate_interval == 0:
                 ev = self.evaluate(rnd, test_batch_size)

@@ -73,11 +73,15 @@ def apply_env_platform() -> None:
     ``JAX_PLATFORMS=cpu`` — so a CPU-requesting launcher (docs/build.py,
     subprocess harnesses) would still try to initialize the (possibly dead)
     TPU backend first and hang. Scripts that honor the env contract call
-    this at startup: if the environment requests a non-axon platform set,
-    re-apply it in-process, with the virtual device count taken from
-    ``XLA_FLAGS`` (default 1)."""
+    this at startup: re-apply the requested platform set in-process. A
+    cpu-only request additionally becomes a virtual-CPU platform with the
+    device count taken from ``XLA_FLAGS`` (default 1); any other non-axon
+    request (tpu, cuda, ...) is re-applied verbatim."""
     want = os.environ.get("JAX_PLATFORMS", "")
-    if want and "axon" not in want.split(","):
+    platforms = [p for p in want.split(",") if p]
+    if not platforms or "axon" in platforms:
+        return
+    if platforms == ["cpu"]:
         import re
 
         m = re.search(
@@ -85,3 +89,8 @@ def apply_env_platform() -> None:
             os.environ.get("XLA_FLAGS", ""),
         )
         force_virtual_cpu(int(m.group(1)) if m else 1)
+        return
+    try:
+        jax.config.update("jax_platforms", want)
+    except RuntimeError:
+        pass  # backend already up

@@ -54,6 +54,8 @@ GALLERY = [
      ["--rounds", "10", "--out", "@TMP@", "--plot", "@TMP@/config1.png"],
      {}, 900),
     ("simulation_on_mnist.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
+    ("fedavg_ipm.py",
+     ["--rounds", "2", "--steps", "2", "--out", "@TMP@"], {}, 900),
     ("robustness_matrix.py",
      ["--rounds", "2", "--out", "@TMP@", "--attacks", "ipm", "--aggs",
       "mean", "geomed"], {}, 900),

@@ -38,6 +38,18 @@ from blades_tpu.utils.platform import apply_env_platform  # noqa: E402
 apply_env_platform()  # honor JAX_PLATFORMS=cpu launchers (docs/build.py)
 
 
+def seed_stats(vals):
+    """mean/min/max/n_seeds summary of per-seed finals (shared summary.json
+    schema across the evidence scripts)."""
+    vals = list(vals)
+    return {
+        "mean": sum(vals) / len(vals),
+        "min": min(vals),
+        "max": max(vals),
+        "n_seeds": len(vals),
+    }
+
+
 def build_dataset(data_root: str, num_clients: int, seed: int):
     from blades_tpu.datasets import MNIST, Synthetic
 
@@ -177,21 +189,12 @@ def main() -> None:
             print(f"{label} seed {seed}: final top1 = {tests[-1]['top1']:.4f}")
         curves[label] = bands[label][0]
 
-    def stats(vals):
-        vals = list(vals)
-        return {
-            "mean": sum(vals) / len(vals),
-            "min": min(vals),
-            "max": max(vals),
-            "n_seeds": len(vals),
-        }
-
     summary = {
         "config": "BASELINE config 1 (mini_example): MLP, 10 clients, "
                   "4xALIE, 100 rounds x 50 local steps",
         "dataset": kind,
         "seeds": args.seeds,
-        "final_top1": {a: stats(finals[a].values()) for a in finals},
+        "final_top1": {a: seed_stats(finals[a].values()) for a in finals},
         "final_top1_per_seed": finals,
         "final_loss": {a: curves[a][-1]["Loss"] for a in curves},
     }

@@ -59,37 +59,63 @@ def main() -> None:
     p.add_argument("--data-root", default=os.path.join(REPO, "data"))
     p.add_argument("--out", default=os.path.join(REPO, "results", "mnist_sweep"))
     p.add_argument("--rounds", type=int, default=10)
+    p.add_argument("--seeds", type=int, nargs="+", default=[1],
+                   help="repeat the sweep per seed; finals reported as "
+                        "mean [min-max] in summary.json")
     args = p.parse_args()
     os.makedirs(args.out, exist_ok=True)
 
     from blades_tpu import Simulator
-    from examples.convergence_config1 import build_dataset
+    from examples.convergence_config1 import build_dataset, seed_stats
 
     curves = {}
+    finals = {agg: {} for agg in AGGS}
     for agg, agg_kws in AGGS.items():
-        ds, kind = build_dataset(args.data_root, num_clients=20, seed=1)
-        sim = Simulator(
-            dataset=ds,
-            aggregator=agg,
-            aggregator_kws=agg_kws,
-            num_byzantine=8,
-            attack="ipm",
-            attack_kws={"epsilon": 100},
-            log_path=os.path.join(args.out, f"{agg}_logs"),
-            seed=1,
+        for seed in args.seeds:
+            tag = f"{agg}_logs" if seed == args.seeds[0] else f"{agg}_s{seed}_logs"
+            ds, kind = build_dataset(args.data_root, num_clients=20, seed=seed)
+            sim = Simulator(
+                dataset=ds,
+                aggregator=agg,
+                aggregator_kws=agg_kws,
+                num_byzantine=8,
+                attack="ipm",
+                attack_kws={"epsilon": 100},
+                log_path=os.path.join(args.out, tag),
+                seed=seed,
+            )
+            sim.run(
+                model="mlp",
+                server_optimizer="SGD",
+                client_optimizer="SGD",
+                loss="crossentropy",
+                global_rounds=args.rounds,
+                local_steps=10,
+                server_lr=1.0,
+                client_lr=0.1,
+            )
+            tests = read_test_records(os.path.join(args.out, tag))
+            finals[agg][seed] = tests[-1]["top1"]
+            if seed == args.seeds[0]:
+                curves[agg] = tests
+            print(f"{agg} seed {seed}: final top1 = {tests[-1]['top1']:.4f}"
+                  f"  ({kind})")
+
+    import json
+
+    with open(os.path.join(args.out, "summary.json"), "w") as f:
+        json.dump(
+            {
+                "config": f"20 clients, 8xIPM eps=100, {args.rounds} rounds "
+                          "x 10 local steps",
+                "seeds": args.seeds,
+                "final_top1": {
+                    a: seed_stats(v.values()) for a, v in finals.items()
+                },
+                "final_top1_per_seed": finals,
+            },
+            f, indent=2,
         )
-        sim.run(
-            model="mlp",
-            server_optimizer="SGD",
-            client_optimizer="SGD",
-            loss="crossentropy",
-            global_rounds=args.rounds,
-            local_steps=10,
-            server_lr=1.0,
-            client_lr=0.1,
-        )
-        curves[agg] = read_test_records(os.path.join(args.out, f"{agg}_logs"))
-        print(f"{agg}: final top1 = {curves[agg][-1]['top1']:.4f}  ({kind})")
 
     import matplotlib
 

@@ -130,6 +130,16 @@ def main():
                       BENCH_LOCAL_STEPS=5, BENCH_WARMUP=1, BENCH_TIMED=3)
             break
 
+    # --- 3b. headline perf levers (VERDICT r3: spend the ~20x headroom) ----
+    # each is one knob off the measured-best default; whichever wins gets
+    # promoted to the default in a follow-up commit
+    child_row("lever_chunks1", BENCH_CHUNKS=1, BENCH_WARMUP=2, BENCH_TIMED=6)
+    child_row("lever_chunks2", BENCH_CHUNKS=2, BENCH_WARMUP=2, BENCH_TIMED=6)
+    child_row("lever_noremat_chunks10", BENCH_REMAT=0, BENCH_CHUNKS=10,
+              BENCH_WARMUP=2, BENCH_TIMED=6)
+    child_row("lever_noremat_chunks20", BENCH_REMAT=0, BENCH_CHUNKS=20,
+              BENCH_WARMUP=2, BENCH_TIMED=6)
+
     # --- 4. stage timings --------------------------------------------------
     log("stage timings")
     rc, out, err = run([sys.executable, "scripts/stage_timing.py"], 1800)

@@ -1,0 +1,132 @@
+"""Real-dataset ingestion paths, executed on byte-exact on-disk formats.
+
+Zero egress means the actual MNIST/CIFAR archives cannot be fetched, so
+these tests synthesize files in the EXACT formats the loaders parse in
+production — IDX2/IDX3 (gzipped and raw, big-endian magic + dims, reference
+counterpart ``src/blades/datasets/mnist.py:46-70``) and CIFAR python-pickle
+batches inside the official tar layout (``cifar10.py:73-101``) — then run
+the full pipeline: parse -> partition -> FLDataset -> one attacked training
+round. When a user drops in the real files, this is the code that runs,
+already exercised end to end.
+"""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+from blades_tpu.datasets import CIFAR10, MNIST
+from blades_tpu.datasets.cifar100 import CIFAR100
+
+
+def _write_idx(tmp, gz=True):
+    rng = np.random.RandomState(0)
+    sets = {
+        "train": (rng.randint(0, 256, (120, 28, 28), dtype=np.uint8),
+                  rng.randint(0, 10, 120).astype(np.uint8)),
+        "t10k": (rng.randint(0, 256, (40, 28, 28), dtype=np.uint8),
+                 rng.randint(0, 10, 40).astype(np.uint8)),
+    }
+    op = (lambda p: gzip.open(p, "wb")) if gz else (lambda p: open(p, "wb"))
+    ext = ".gz" if gz else ""
+    for split, (x, y) in sets.items():
+        with op(os.path.join(tmp, f"{split}-images-idx3-ubyte{ext}")) as f:
+            f.write(struct.pack(">IIII", 2051, len(x), 28, 28))
+            f.write(x.tobytes())
+        with op(os.path.join(tmp, f"{split}-labels-idx1-ubyte{ext}")) as f:
+            f.write(struct.pack(">II", 2049, len(y)))
+            f.write(y.tobytes())
+    return sets
+
+
+@pytest.mark.parametrize("gz", [True, False])
+def test_mnist_idx_roundtrip(tmp_path, gz):
+    sets = _write_idx(str(tmp_path), gz=gz)
+    ds = MNIST(data_root=str(tmp_path), num_clients=4, train_bs=8, cache=False)
+    tx, ty, ex, ey = ds.load_raw()
+    np.testing.assert_array_equal(tx[..., 0], sets["train"][0])
+    np.testing.assert_array_equal(ty, sets["train"][1].astype(np.int32))
+    np.testing.assert_array_equal(ex[..., 0], sets["t10k"][0])
+    np.testing.assert_array_equal(ey, sets["t10k"][1].astype(np.int32))
+
+
+def test_mnist_idx_to_training_round(tmp_path):
+    """IDX files -> partition -> FLDataset -> one attacked federated round."""
+    from blades_tpu import Simulator
+
+    _write_idx(str(tmp_path))
+    ds = MNIST(data_root=str(tmp_path), num_clients=4, train_bs=8, cache=False)
+    sim = Simulator(dataset=ds, aggregator="median", num_byzantine=1,
+                    attack="ipm", log_path=str(tmp_path / "out"), seed=0)
+    sim.run("mlp", global_rounds=1, local_steps=1, train_batch_size=8,
+            validate_interval=1)
+
+
+def _write_cifar(tmp, n_train_per_batch=20, n_test=20, coarse=False):
+    rng = np.random.RandomState(1)
+    base = os.path.join(tmp, "cifar-10-batches-py")
+    os.makedirs(base, exist_ok=True)
+    batches = {}
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        n = n_test if name == "test_batch" else n_train_per_batch
+        x = rng.randint(0, 256, (n, 3 * 32 * 32), dtype=np.uint8)
+        y = rng.randint(0, 10, n).tolist()
+        with open(os.path.join(base, name), "wb") as f:
+            pickle.dump({b"data": x, b"labels": y}, f)
+        batches[name] = (x, y)
+    return base, batches
+
+
+def test_cifar10_pickle_batches_roundtrip(tmp_path):
+    base, batches = _write_cifar(str(tmp_path))
+    ds = CIFAR10(data_root=str(tmp_path), num_clients=5, train_bs=8,
+                 cache=False)
+    tx, ty, ex, ey = ds.load_raw()
+    assert tx.shape == (100, 32, 32, 3) and tx.dtype == np.uint8
+    assert ex.shape == (20, 32, 32, 3)
+    # NHWC transpose of the row-major CHW on-disk layout, first image
+    first = batches["data_batch_1"][0][0].reshape(3, 32, 32).transpose(1, 2, 0)
+    np.testing.assert_array_equal(tx[0], first)
+    np.testing.assert_array_equal(ey, np.asarray(batches["test_batch"][1]))
+
+
+def test_cifar10_tar_extraction(tmp_path):
+    """The official tarball layout is auto-extracted on first use."""
+    inner = tmp_path / "stage"
+    inner.mkdir()
+    base, _ = _write_cifar(str(inner))
+    tar_path = tmp_path / "cifar-10-python.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(base, arcname="cifar-10-batches-py")
+    ds = CIFAR10(data_root=str(tmp_path), num_clients=5, train_bs=8,
+                 cache=False)
+    tx, ty, ex, ey = ds.load_raw()
+    assert tx.shape == (100, 32, 32, 3)
+
+
+def test_cifar100_fine_labels(tmp_path):
+    """CIFAR-100 stores 'fine_labels'; loader must read them."""
+    rng = np.random.RandomState(2)
+    base = os.path.join(str(tmp_path), "cifar-100-python")
+    os.makedirs(base)
+    for name, n in (("train", 40), ("test", 20)):
+        x = rng.randint(0, 256, (n, 3 * 32 * 32), dtype=np.uint8)
+        y = rng.randint(0, 100, n).tolist()
+        with open(os.path.join(base, name), "wb") as f:
+            pickle.dump({b"data": x, b"fine_labels": y}, f)
+    ds = CIFAR100(data_root=str(tmp_path), num_clients=4, train_bs=8,
+                  cache=False)
+    tx, ty, ex, ey = ds.load_raw()
+    assert tx.shape == (40, 32, 32, 3)
+    assert int(ty.max()) <= 99 and ty.dtype == np.int32
+
+
+def test_missing_data_raises_actionable_error(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no network downloads"):
+        MNIST(data_root=str(tmp_path / "nope"), cache=False).load_raw()
+    with pytest.raises(FileNotFoundError, match="no network downloads"):
+        CIFAR10(data_root=str(tmp_path / "nope"), cache=False).load_raw()
