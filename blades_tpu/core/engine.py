@@ -363,7 +363,10 @@ class RoundEngine:
             updates,
             state.agg_state,
             trusted_mask=self.trusted_mask,
-            params_flat=None,
+            # current flat params for defenses that track the model
+            # trajectory (byzantinesgd's A-accumulator); dead code — and
+            # free — for every aggregator that ignores it
+            params_flat=ravel(state.params),
             key=jax.random.fold_in(round_key, rng.AGG),
         )
 

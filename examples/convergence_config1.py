@@ -201,7 +201,10 @@ def main() -> None:
     with open(os.path.join(args.out, "summary.json"), "w") as f:
         json.dump(summary, f, indent=2)
     plot(curves, args.plot, bands=bands)
-    print(json.dumps(summary, indent=2))
+    # compact print: the docs gallery keeps only the last few stdout lines,
+    # so the headline numbers must fit (full detail lives in summary.json)
+    print(json.dumps({k: v for k, v in summary.items()
+                      if k != "final_top1_per_seed"}, indent=2))
 
 
 if __name__ == "__main__":

@@ -1,5 +1,4 @@
-"""Krum-collapse adjudication: IPM on the fedavg path, cross-checked against
-the reference's own Krum.
+"""Krum-collapse adjudication: fedavg-path IPM vs the reference's own Krum.
 
 Round 3 committed a striking artifact (``results/fedavg_ipm``): with 20
 clients, 8 of them running IPM, 30 fedavg rounds (10 local Adam steps,
@@ -158,8 +157,12 @@ def main() -> None:
         adj_rows.extend(rows)
         print(f"{agg}: final top1 = {top1:.4f}")
 
-    agree = [r.get("agree_with_reference") for r in adj_rows
+    agree = [r["agree_with_reference"] for r in adj_rows
              if "agree_with_reference" in r]
+    diffs = [r["aggregate_max_abs_diff"] for r in adj_rows
+             if "aggregate_max_abs_diff" in r]
+    agreement = (sum(agree) / len(agree)) if agree else None
+    max_diff = max(diffs) if diffs else None
     byz_picked = [r["selected_is_byzantine"] for r in adj_rows]
     # length of the opening byzantine-captured streak — the phase that
     # decides the run (once the model is wrecked, occasional honest
@@ -169,25 +172,24 @@ def main() -> None:
         if not b:
             break
         streak += 1
+    cross_check = (
+        "on every round's actual update matrix the reference's own Krum "
+        f"selects the identical row (agreement {agreement}, max aggregate "
+        f"diff {max_diff})"
+        if agree
+        else "reference tree not mounted — cross-check did not run"
+    )
     verdict = {
         "rounds_checked": len(adj_rows),
         "reference_available": ref_krum is not None,
-        "selection_agreement_with_reference":
-            (sum(agree) / len(agree)) if agree else None,
+        "selection_agreement_with_reference": agreement,
         "fraction_rounds_krum_selected_byzantine":
             sum(byz_picked) / max(1, len(byz_picked)),
         "initial_byzantine_capture_streak": streak,
-        "max_aggregate_abs_diff": max(
-            (r.get("aggregate_max_abs_diff", 0.0) for r in adj_rows),
-            default=None,
-        ),
+        "max_aggregate_abs_diff": max_diff,
         "conclusion": (
             "krum collapse under IPM is genuine, not an implementation "
-            "bug: on every round's actual update matrix the reference's "
-            "own Krum selects the identical row (agreement "
-            f"{(sum(agree) / len(agree)) if agree else None}, max aggregate "
-            "diff "
-            f"{max((r.get('aggregate_max_abs_diff', 0.0) for r in adj_rows), default=None)}). "
+            f"bug: {cross_check}. "
             f"Krum is byzantine-captured for the first {streak} consecutive "
             f"rounds ({sum(byz_picked)}/{len(byz_picked)} overall): the "
             "identical IPM replicas have zero pairwise distance and win "

@@ -1,0 +1,38 @@
+"""Platform forcing: the env contract must be binding in a fresh process.
+
+The TPU plugin's sitecustomize rewrites ``jax_platforms`` to ``axon,cpu``
+at interpreter start, which made ``JAX_PLATFORMS=cpu python ...`` hang on
+a dead tunnel (backend init blocks forever). ``apply_env_platform()`` is
+the in-process re-assertion every example runs at startup; this test
+proves it in a real subprocess — the only place the sitecustomize
+interaction exists.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def test_apply_env_platform_binds_cpu_request():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=3"
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sys; sys.path.insert(0, %r)\n"
+            "from blades_tpu.utils.platform import apply_env_platform\n"
+            "apply_env_platform()\n"
+            "import jax\n"
+            "print('RESULT', jax.default_backend(), jax.device_count())"
+            % os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    line = [l for l in out.stdout.splitlines() if l.startswith("RESULT")][-1]
+    assert line.split() == ["RESULT", "cpu", "3"]

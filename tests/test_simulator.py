@@ -144,6 +144,18 @@ def test_trusted_clients_flow_to_fltrust(tmp_path):
             validate_interval=2)
 
 
+def test_byzantinesgd_runs_in_engine(tmp_path):
+    """The model-trajectory context (params_flat) reaches stateful defenses
+    that need it inside the jitted round."""
+    sim = _sim(tmp_path, aggregator="byzantinesgd",
+               aggregator_kws={"th_A": 1e6, "th_B": 1e6, "th_V": 1e6})
+    sim.run("mlp", global_rounds=2, local_steps=1, train_batch_size=8,
+            validate_interval=2)
+    agg_state = sim.server.state.agg_state
+    assert bool(agg_state["initialized"])  # params_flat context arrived
+    assert bool(jnp.all(agg_state["good"]))  # huge thresholds: none filtered
+
+
 def test_lr_scheduler_dict(tmp_path):
     sim = _sim(tmp_path)
     fn = sim._resolve_schedule({"milestones": [1], "gamma": 0.1}, 1.0)
