@@ -70,6 +70,72 @@ def run_round(plan, num_clients: int, cx, cy, num_byzantine: int):
     return metrics
 
 
+def run_local_cluster(
+    n_processes: int = 2,
+    devices_per_process: int = 4,
+    timeout: float = 900.0,
+):
+    """Spawn ``n_processes`` workers joined into one localhost
+    ``jax.distributed`` cluster and collect their DIST_RESULT rows.
+
+    The single shared harness behind the pytest cross-process test and
+    ``__graft_entry__.dryrun_multiprocess``. Always reaps the workers: a
+    hung or failed worker must not linger — stuck python processes can
+    hold the single-chip TPU lease on the dev machines this runs on.
+
+    Returns ``{process_id: result_dict}``; raises RuntimeError on any
+    worker failure or timeout.
+    """
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers set their own device count
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "blades_tpu.parallel._dist_worker",
+             str(pid), str(n_processes), str(port),
+             str(devices_per_process)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=repo,
+        )
+        for pid in range(n_processes)
+    ]
+    results = {}
+    try:
+        for pid, p in enumerate(procs):
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                raise RuntimeError(f"worker {pid} timed out after {timeout}s")
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"worker {pid} failed (rc={p.returncode}):\n{err[-2000:]}"
+                )
+            for line in out.splitlines():
+                if line.startswith("DIST_RESULT "):
+                    results[pid] = json.loads(line[len("DIST_RESULT "):])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    missing = set(range(n_processes)) - set(results)
+    if missing:
+        raise RuntimeError(f"no DIST_RESULT from workers {sorted(missing)}")
+    return results
+
+
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     pid, nproc, port = int(argv[0]), int(argv[1]), int(argv[2])
