@@ -108,13 +108,21 @@ class _CompositeAttack(Attack):
         return jax.lax.switch(self._branch_table[client_idx], branches, grads)
 
     def on_updates(self, updates, byz_mask, key, state=()):
-        k = updates.shape[0]
+        # Reference semantics (``simulator.py:239-241`` +
+        # ``alieclient.py:27-31``): every omniscient callback excludes the
+        # FULL byzantine population from its honest statistics and reads the
+        # clients' uploaded (pre-attack) updates — so each attacker here sees
+        # the pre-attack snapshot with the engine's full ``byz_mask``, never
+        # a one-hot submask, and never another attacker's corruption. Each
+        # attacker then writes only its own row of the output.
+        pre = updates
+        out = updates
         new_states = []
         for (idx, client), st in zip(self.entries, state):
-            submask = jnp.zeros(k, bool).at[idx].set(True)
-            updates, st = client.omniscient_callback(updates, submask, key, st)
+            rewritten, st = client.omniscient_callback(pre, byz_mask, key, st)
+            out = out.at[idx].set(rewritten[idx])
             new_states.append(st)
-        return updates, tuple(new_states)
+        return out, tuple(new_states)
 
 
 class Simulator:

@@ -444,3 +444,54 @@ def test_client_local_training_matches_reference(attack_first):
         np.testing.assert_allclose(
             ours[i].reshape(din, dout), expected[i], rtol=1e-4, atol=1e-5,
         )
+
+
+def test_mixed_registered_omniscients_match_reference():
+    """A mixed REGISTERED population (2 x ALIE + 1 x IPM via
+    ``register_attackers``) against the reference's callback loop
+    (``simulator.py:239-241``) on the same population: every omniscient
+    callback must exclude ALL byzantine clients from its honest statistics
+    (``alieclient.py:27-31``) and read the pre-attack uploads — never another
+    registered attacker's corrupted row. Guards the ``_CompositeAttack``
+    masking fix (one-hot submasks made ALIE treat the other attackers' rows
+    as honest)."""
+    from blades_tpu.attackers import get_attack
+    from blades_tpu.client import ByzantineClient
+    from blades_tpu.simulator import _CompositeAttack
+
+    n, f = 10, 3
+    m = gaussian(k=n, d=30, seed=4)
+    byz = np.arange(n) < f
+
+    ref_attackers = [
+        ref.attackers.alieclient.AlieClient(num_clients=n, num_byzantine=f),
+        ref.attackers.alieclient.AlieClient(num_clients=n, num_byzantine=f),
+        ref.attackers.ipmclient.IpmClient(epsilon=0.5),
+    ]
+    clients = []
+    for i, row in enumerate(t(m)):
+        c = ref_attackers[i] if i < f else ref.client.BladesClient(id=str(i))
+        c.set_id(str(i))
+        c.save_update(row)
+        clients.append(c)
+    sim = _FakeSimulator(clients)
+    for c in ref_attackers:
+        c.omniscient_callback(sim)
+    theirs = np.stack([c.get_update().numpy() for c in clients])
+
+    comp = _CompositeAttack(
+        [
+            (0, ByzantineClient(
+                attack=get_attack("alie", num_clients=n, num_byzantine=f))),
+            (1, ByzantineClient(
+                attack=get_attack("alie", num_clients=n, num_byzantine=f))),
+            (2, ByzantineClient(attack=get_attack("ipm", epsilon=0.5))),
+        ]
+    )
+    state = comp.init_state(n, m.shape[1])
+    out, _ = comp.on_updates(
+        jnp.asarray(m), jnp.asarray(byz), jax.random.PRNGKey(0), state
+    )
+    np.testing.assert_allclose(np.asarray(out), theirs, rtol=1e-4, atol=1e-5)
+    # honest rows bit-untouched
+    np.testing.assert_array_equal(np.asarray(out[f:]), m[f:])
