@@ -33,6 +33,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 METRIC = "cifar10_fedsgd_trimmedmean_1000c_rounds_per_sec"
 SAMPLES_PER_CLIENT = 50
 WARMUP, TIMED = 3, 10
+# TPU v5e bf16 peak (MXU), the denominator of the MFU field
+PEAK_TFLOPS_V5E = 197.0
 
 
 # --------------------------------------------------------------------------
@@ -78,13 +80,33 @@ def _maybe_force_cpu() -> None:
 
 def _make_agg(get_aggregator, agg_name: str, num_byz: int, explicit: bool):
     """Construct the aggregator, forwarding BENCH_NUM_BYZ to the ones whose
-    constructor keys on f (krum/trimmedmean/dnc); the rest take defaults."""
-    if explicit:
-        try:
-            return get_aggregator(agg_name, num_byzantine=num_byz)
-        except TypeError:
-            pass
-    return get_aggregator(agg_name)
+    constructor keys on f (krum/trimmedmean/dnc); the rest take defaults.
+
+    Returns ``(aggregator, kwargs_used)`` — the kwargs actually passed go
+    into the result payload, so an explicitly requested BENCH_NUM_BYZ that
+    the constructor does not accept shows up as ``agg_kwargs: {}`` instead
+    of being silently ignored. The decision is made by signature inspection,
+    never by swallowing TypeError (a genuine constructor bug must surface)."""
+    if not explicit:
+        return get_aggregator(agg_name), {}
+    import inspect
+
+    from blades_tpu.aggregators import AGGREGATORS
+
+    cls = AGGREGATORS.get(agg_name)
+    # no-arg aggregators (mean/median/...) inherit object.__init__, whose
+    # (*args, **kwargs) signature must not count as accepting kwargs
+    params = (
+        inspect.signature(cls.__init__).parameters
+        if cls is not None and cls.__init__ is not object.__init__
+        else {}
+    )
+    if "num_byzantine" in params or any(
+        p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values()
+    ):
+        kw = {"num_byzantine": num_byz}
+        return get_aggregator(agg_name, **kw), kw
+    return get_aggregator(agg_name), {}
 
 
 def child_main() -> None:
@@ -180,6 +202,9 @@ def child_main() -> None:
         )
         params = spec.init(jax.random.PRNGKey(0))
 
+        agg, agg_kwargs = _make_agg(
+            get_aggregator, agg_name, num_byz, bool(num_byz_env)
+        )
         devices = jax.devices()
         plan = make_plan(make_mesh(devices)) if len(devices) > 1 else None
         if plan is not None:
@@ -194,9 +219,7 @@ def child_main() -> None:
             # aggregators that key on f (krum/trimmedmean/...) must see the
             # actual byzantine count; default construction (headline path)
             # keeps each aggregator's own reference-parity default
-            aggregator=_make_agg(
-                get_aggregator, agg_name, num_byz, bool(num_byz_env)
-            ),
+            aggregator=agg,
             client_opt=ClientOptSpec(name=client_opt_name),
             server_opt=ServerOptSpec(),
             num_classes=num_classes,
@@ -239,6 +262,32 @@ def child_main() -> None:
         loss = float(m.train_loss)
         if not np.isfinite(loss):
             raise RuntimeError(f"non-finite loss {loss}")
+
+        # XLA-cost-model FLOPs of the exact compiled round program (the
+        # basis of docs/performance.md's MFU accounting); cost_analysis is
+        # best-effort — some backends/attachment modes don't expose it
+        tflop_per_round = None
+        try:
+            ca = (
+                engine._round_jit.lower(
+                    state,
+                    cx,
+                    cy,
+                    jnp.asarray(0.1, jnp.float32),
+                    jnp.asarray(1.0, jnp.float32),
+                    key,
+                )
+                .compile()
+                .cost_analysis()
+            )
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            flops = float(ca.get("flops", 0.0))
+            if flops > 0:
+                tflop_per_round = flops / 1e12
+        except Exception:
+            pass
+
         print(
             "BENCH_CHILD_RESULT "
             + json.dumps(
@@ -247,11 +296,13 @@ def child_main() -> None:
                     "clients": k,
                     "model": model_name,
                     "agg": agg_name,
+                    "agg_kwargs": agg_kwargs,
                     "attack": attack_name,
                     "num_byz": num_byz,
                     "client_opt": client_opt_name,
                     "local_steps": local_steps,
                     "train_loss": loss,
+                    "tflop_per_round": tflop_per_round,
                     "platform": devices[0].platform,
                     "n_devices": len(devices),
                 }
@@ -448,6 +499,19 @@ def main() -> None:
     if errors:
         payload["attempt_errors"] = "; ".join(errors)[:500]
     payload["platform"] = result.get("platform")
+    # efficiency fields: sustained TFLOPS from the XLA cost model of the
+    # exact compiled round program, and MFU against the v5e bf16 peak.
+    # Carried on every path; mfu is null off-accelerator (the CPU fallback
+    # has no meaningful MXU peak to normalize against).
+    tflop = result.get("tflop_per_round")
+    # 6 decimals: CPU-fallback magnitudes (~1e-4 TFLOPS) must not round
+    # to a misleading 0.0
+    payload["tflops_sustained"] = round(tflop * rps, 6) if tflop else None
+    payload["mfu"] = (
+        round(tflop * rps / PEAK_TFLOPS_V5E, 4)
+        if tflop and result.get("platform") in ("tpu", "axon")
+        else None
+    )
     if result.get("platform") == "cpu":
         prior = prior_tpu_capture()
         if prior is not None:

@@ -95,3 +95,46 @@ def test_total_failure_emits_error_json(bench, monkeypatch, capsys):
     assert payload["value"] is None
     assert "boom" in payload["error"]
     assert payload["metric"]  # the line is still schema-complete
+
+def test_efficiency_fields_on_tpu_and_fallback(bench, monkeypatch, capsys):
+    """The JSON contract carries tflops_sustained + mfu on every path
+    (VERDICT r4 #8): computed from the child's cost-model TFLOP on an
+    accelerator, null-mfu on the CPU fallback, null-both when the child
+    could not read the cost model."""
+    probe = ({"probe": "ok", "platform": "axon", "n_devices": 1}, None)
+    full = (
+        {"rounds_per_sec": 2.0, "clients": 1000, "platform": "axon",
+         "tflop_per_round": 6.92},
+        None,
+    )
+    payload, _, _ = run_main(bench, monkeypatch, capsys, [probe, full])
+    assert payload["tflops_sustained"] == round(6.92 * 2.0, 6)
+    assert payload["mfu"] == round(6.92 * 2.0 / bench.PEAK_TFLOPS_V5E, 4)
+
+    probe_down = (None, "timeout after 240s")
+    cpu = (
+        {"rounds_per_sec": 0.02, "clients": 8, "platform": "cpu",
+         "tflop_per_round": 0.01},
+        None,
+    )
+    payload, _, _ = run_main(bench, monkeypatch, capsys, [probe_down, cpu])
+    assert payload["tflops_sustained"] == round(0.01 * 0.02, 6)
+    assert payload["mfu"] is None  # no meaningful peak off-accelerator
+
+    cpu_no_ca = ({"rounds_per_sec": 0.02, "clients": 8, "platform": "cpu"}, None)
+    payload, _, _ = run_main(bench, monkeypatch, capsys, [probe_down, cpu_no_ca])
+    assert payload["tflops_sustained"] is None and payload["mfu"] is None
+
+def test_make_agg_signature_dispatch(bench):
+    """num_byzantine is forwarded only to constructors that declare it;
+    no-arg aggregators (object.__init__) must neither crash nor silently
+    claim kwargs were applied."""
+    from blades_tpu.aggregators import get_aggregator
+
+    agg, kw = bench._make_agg(get_aggregator, "median", 4, True)
+    assert kw == {}
+    agg, kw = bench._make_agg(get_aggregator, "trimmedmean", 4, True)
+    assert kw == {"num_byzantine": 4}
+    assert agg.b == 4
+    _, kw = bench._make_agg(get_aggregator, "krum", 4, False)
+    assert kw == {}  # headline path: defaults, nothing forwarded
