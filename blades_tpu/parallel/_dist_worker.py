@@ -74,14 +74,27 @@ def run_local_cluster(
     n_processes: int = 2,
     devices_per_process: int = 4,
     timeout: float = 900.0,
+    _fault_injector=None,
 ):
     """Spawn ``n_processes`` workers joined into one localhost
     ``jax.distributed`` cluster and collect their DIST_RESULT rows.
 
-    The single shared harness behind the pytest cross-process test and
-    ``__graft_entry__.dryrun_multiprocess``. Always reaps the workers: a
-    hung or failed worker must not linger — stuck python processes can
-    hold the single-chip TPU lease on the dev machines this runs on.
+    The single shared harness behind the pytest cross-process tests and
+    ``__graft_entry__.dryrun_multiprocess``. Failure handling:
+
+    - Worker output goes to temp FILES, never pipes: a worker spewing
+      verbose XLA logging into a full 64 KB pipe would block mid-round
+      before reaching the ``sync_global_devices`` barrier and deadlock the
+      whole cluster into a slow timeout instead of a result.
+    - Workers are polled CONCURRENTLY; the first nonzero exit tears the
+      cluster down immediately (its peers are blocked at the barrier
+      waiting for the dead process and would otherwise hang until the
+      harness timeout) and raises with that worker's stderr tail.
+    - Always reaps: a hung worker must not linger — stuck python processes
+      can hold the single-chip TPU lease on the dev machines this runs on.
+
+    ``_fault_injector(procs)``: test hook invoked once right after spawn
+    (used by the failure-path test to kill a worker mid-flight).
 
     Returns ``{process_id: result_dict}``; raises RuntimeError on any
     worker failure or timeout.
@@ -90,6 +103,8 @@ def run_local_cluster(
     import socket
     import subprocess
     import sys
+    import tempfile
+    import time
 
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -101,35 +116,62 @@ def run_local_cluster(
     repo = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-m", "blades_tpu.parallel._dist_worker",
-             str(pid), str(n_processes), str(port),
-             str(devices_per_process)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env, cwd=repo,
-        )
-        for pid in range(n_processes)
-    ]
     results = {}
-    try:
-        for pid, p in enumerate(procs):
-            try:
-                out, err = p.communicate(timeout=timeout)
-            except subprocess.TimeoutExpired:
-                raise RuntimeError(f"worker {pid} timed out after {timeout}s")
-            if p.returncode != 0:
-                raise RuntimeError(
-                    f"worker {pid} failed (rc={p.returncode}):\n{err[-2000:]}"
+    with tempfile.TemporaryDirectory(prefix="blades_dist_") as tmp:
+        outs, errs, procs = [], [], []
+        try:
+            for pid in range(n_processes):
+                fo = open(os.path.join(tmp, f"out{pid}"), "w+")
+                fe = open(os.path.join(tmp, f"err{pid}"), "w+")
+                outs.append(fo)
+                errs.append(fe)
+                procs.append(
+                    subprocess.Popen(
+                        [sys.executable, "-m",
+                         "blades_tpu.parallel._dist_worker",
+                         str(pid), str(n_processes), str(port),
+                         str(devices_per_process)],
+                        stdout=fo, stderr=fe, text=True, env=env, cwd=repo,
+                    )
                 )
-            for line in out.splitlines():
-                if line.startswith("DIST_RESULT "):
-                    results[pid] = json.loads(line[len("DIST_RESULT "):])
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait()
+            if _fault_injector is not None:
+                _fault_injector(procs)
+            deadline = time.time() + timeout
+            pending = dict(enumerate(procs))
+            while pending:
+                for pid in sorted(pending):
+                    rc = pending[pid].poll()
+                    if rc is None:
+                        continue
+                    del pending[pid]
+                    if rc != 0:
+                        errs[pid].flush()
+                        errs[pid].seek(0)
+                        tail = errs[pid].read()[-2000:]
+                        raise RuntimeError(
+                            f"worker {pid} failed (rc={rc}); tearing down "
+                            f"the remaining {len(pending)} worker(s):\n{tail}"
+                        )
+                if pending and time.time() > deadline:
+                    raise RuntimeError(
+                        f"workers {sorted(pending)} timed out after "
+                        f"{timeout}s"
+                    )
+                if pending:
+                    time.sleep(0.2)
+            for pid, fo in enumerate(outs):
+                fo.flush()
+                fo.seek(0)
+                for line in fo.read().splitlines():
+                    if line.startswith("DIST_RESULT "):
+                        results[pid] = json.loads(line[len("DIST_RESULT "):])
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            for f in outs + errs:
+                f.close()
     missing = set(range(n_processes)) - set(results)
     if missing:
         raise RuntimeError(f"no DIST_RESULT from workers {sorted(missing)}")

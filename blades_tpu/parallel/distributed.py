@@ -26,6 +26,7 @@ must provide; ``jax.make_array_from_process_local_data`` assembles the global
 from __future__ import annotations
 
 import math
+import os
 import warnings
 from typing import Optional, Sequence, Tuple
 
@@ -65,7 +66,34 @@ def initialize(
         if coordinator_address is not None or num_processes is not None:
             raise
         msg = str(e).lower()
+        if (
+            "before any jax calls" in msg
+            or "before any jax computations" in msg
+            or "backend already initialized" in msg
+        ):
+            # the late-call hazard: the XLA backend was touched before this
+            # call. In a plain single-host process (tests, notebooks) that
+            # is harmless — quietly stay local. But when the environment
+            # says this IS a multi-host job, proceeding would silently
+            # degrade the pod to num_hosts independent single-host
+            # trainings, so it must be a hard error, not a warning. This
+            # classification must run BEFORE the plain double-call check:
+            # "backend already initialized" contains "already initialized".
+            if _cluster_env_hints():
+                present = [v for v in _CLUSTER_ENV_VARS if os.environ.get(v)]
+                raise RuntimeError(
+                    "jax.distributed.initialize() was called after the XLA "
+                    "backend was already initialized, and multi-host cluster "
+                    f"environment variables are set ({', '.join(present)}). "
+                    "Continuing would silently degrade this pod to "
+                    "single-host training. Call "
+                    "blades_tpu.parallel.distributed.initialize() before "
+                    "any JAX call that touches the backend (jax.devices(), "
+                    "any computation)."
+                ) from e
+            return
         if "already initialized" in msg:
+            # double call of initialize() itself: idempotent no-op
             return
         if (
             "coordinator_address should be defined" in msg
@@ -75,12 +103,6 @@ def initialize(
             # genuine single-host run: autodetect found no cluster env
             # (jax raises ValueError("coordinator_address should be
             # defined.") when no cluster environment is present)
-            return
-        if "before any jax calls" in msg and not _cluster_env_hints():
-            # backend already initialized in a plain single-host process
-            # (tests, notebooks) — harmless; but with cluster env present
-            # this ordering bug WOULD silently fracture a multi-host job,
-            # so only stay quiet when no cluster signals exist
             return
         # anything else (coordinator unreachable, partial cluster env,
         # timeout) must NOT silently degrade a real multi-host job into K
@@ -108,10 +130,21 @@ _CLUSTER_ENV_VARS = (
 
 
 def _cluster_env_hints() -> bool:
-    """True when the environment looks like a multi-host cluster job."""
-    import os
+    """True when the environment looks like a MULTI-host cluster job.
 
-    return any(os.environ.get(v) for v in _CLUSTER_ENV_VARS)
+    ``TPU_WORKER_HOSTNAMES`` counts only when it names more than one host:
+    single-host attachment modes export it with one entry (the axon
+    tunnel sets ``TPU_WORKER_HOSTNAMES=localhost`` in every python
+    process), and treating that as a pod would turn the harmless
+    late-call no-op into a spurious hard error on dev machines."""
+    for v in _CLUSTER_ENV_VARS:
+        val = os.environ.get(v)
+        if not val:
+            continue
+        if v == "TPU_WORKER_HOSTNAMES" and len(val.split(",")) < 2:
+            continue
+        return True
+    return False
 
 
 def make_global_mesh(

