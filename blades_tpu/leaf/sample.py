@@ -21,8 +21,9 @@ def sample_leaf(
 ):
     """``iid_num_users`` passes the synthetic-user count through exactly;
     ``iid_user_frac`` (kept for reference CLI parity) derives it from the
-    original population and can truncate under float error (e.g. 3/147
-    round-trips to 2 via ``int(frac * len)``)."""
+    original population via the reference's ``int(round(frac * len))``
+    (floor 1) — rounding, so fractions that are exact user counts
+    round-trip (3/147 of 147 users -> 3)."""
     rng = random.Random(seed)
     tot = sum(data["num_samples"])
     budget = int(fraction * tot)
@@ -37,7 +38,12 @@ def sample_leaf(
         if iid_num_users is not None:
             num_users = max(1, int(iid_num_users))
         else:
-            num_users = max(1, round(iid_user_frac * len(data["users"])))
+            # reference semantics exactly: int(round(u * num_users)) with a
+            # floor of 1 (sample.py:94-96 in the reference's
+            # models/utils/sample.py) — it ROUNDS, so 3/147 of 147 users
+            # yields 3, not int-truncated 2; exact counts go through
+            # iid_num_users
+            num_users = max(1, int(round(iid_user_frac * len(data["users"]))))
         groups = iid_divide(pairs, num_users)
         users = [str(i) for i in range(num_users)]
         return {

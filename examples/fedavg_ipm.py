@@ -15,9 +15,13 @@ round the actual post-attack ``[K, D]`` update matrix is fed to
    ``/root/reference/src/blades/aggregators/krum.py`` (torch),
 
 recording each stack's selected client row. The committed result
-(``results/fedavg_ipm/adjudication.json``): all three select the SAME row
-every round, and that row is always byzantine — the collapse is a property
-of Krum-vs-IPM, not of this implementation. Mechanism: the 8 IPM rows are
+(``results/fedavg_ipm/adjudication.json``): the reference-parity stack and
+the reference's own Krum select the SAME row in all 30 rounds (agreement
+1.0, max aggregate diff 0.0), and Krum is byzantine-captured for the first
+11 consecutive rounds (14/30 overall) — long enough to wreck the model;
+the later honest selections are single-client Adam updates that cannot
+recover it. The collapse is a property of Krum-vs-IPM, not of this
+implementation. Mechanism: the 8 IPM rows are
 bit-identical (every byzantine uploads ``-eps * mean(honest)``), so they
 give each other pairwise distance 0 and win the sum-of-nearest-neighbors
 score every round; the server then applies ``-0.5 * mean(honest)`` — a

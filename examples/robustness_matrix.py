@@ -51,6 +51,16 @@ BUDGET_AGGS = {"trimmedmean", "krum", "dnc"}
 #   rule: ("min", x) = defense holds, top1 >= x
 #         ("max", x) = attack wins,   top1 <= x
 #         ("range", lo, hi) = degraded but not destroyed
+#         ("band_rel", lo, d) = defense holds (top1 >= lo) BUT the attack
+#             still measurably bites: top1 <= this column's "none" cell - d.
+#             Used where absolute floors are too loose to catch an
+#             attack-becomes-no-op regression (VERDICT r4 weak #5): ALIE's
+#             committed damage is -0.126 (median) / -0.119 (trimmedmean) at
+#             seed 1, so d=0.05 leaves seed room while a stubbed-out ALIE
+#             (attacked == unattacked) fails the cell. The other ALIE
+#             columns measured deltas within seed noise (mean +0.042,
+#             geomed/krum/dnc negative) — no relative bound is supportable
+#             there, so they keep absolute floors.
 EXPECTATIONS = {
     "none": {agg: ("min", 0.50) for agg in AGGS},
     "noise": {
@@ -81,6 +91,8 @@ EXPECTATIONS = {
     },
     "alie": {
         **{a: ("min", 0.50) for a in AGGS},
+        "median": ("band_rel", 0.50, 0.05),
+        "trimmedmean": ("band_rel", 0.50, 0.05),
         "dnc": ("min", 0.65),
     },
     "ipm": {
@@ -104,12 +116,17 @@ def evaluate_expectations(matrix):
     for attack, cells in EXPECTATIONS.items():
         for agg, rule in cells.items():
             value = matrix.get(attack, {}).get(agg)
+            baseline = matrix.get("none", {}).get(agg)
             if value is None:
                 ok = False
             elif rule[0] == "min":
                 ok = value >= rule[1]
             elif rule[0] == "max":
                 ok = value <= rule[1]
+            elif rule[0] == "band_rel":
+                ok = baseline is not None and (
+                    rule[1] <= value <= baseline - rule[2]
+                )
             else:
                 ok = rule[1] <= value <= rule[2]
             ok_all = ok_all and ok
@@ -217,7 +234,14 @@ def main() -> None:
         rows, ok = evaluate_expectations(matrix)
         with open(os.path.join(args.out, "summary.json"), "w") as f:
             json.dump(
-                {"rounds": matrix["_rounds"], "all_ok": ok, "cells": rows},
+                {
+                    "rounds": matrix["_rounds"],
+                    # every krum cell uses the d^2 paper default; the
+                    # reference-compat d^4 ranking is Krum(distance_power=4)
+                    "krum_variant": "distance_power=2 (paper default)",
+                    "all_ok": ok,
+                    "cells": rows,
+                },
                 f, indent=1,
             )
         bad = [r for r in rows if not r["ok"]]

@@ -62,3 +62,18 @@ def test_summary_in_sync(matrix):
         rec = recorded[(r["attack"], r["agg"])]
         assert rec["top1"] == pytest.approx(r["top1"])
         assert rec["ok"] == r["ok"]
+
+
+def test_gate_detects_neutered_alie(matrix):
+    """Mutation test (VERDICT r4 #5): stub ALIE out (attacked cells copied
+    from the unattacked row) — the relative band_rel cells must catch it.
+    The pre-r5 absolute floors passed this mutation silently."""
+    from examples.robustness_matrix import evaluate_expectations
+
+    mutated = json.loads(json.dumps(matrix))
+    mutated["alie"] = dict(mutated["none"])
+    rows, ok = evaluate_expectations(mutated)
+    assert not ok
+    bad = {(r["attack"], r["agg"]) for r in rows if not r["ok"]}
+    assert ("alie", "median") in bad
+    assert ("alie", "trimmedmean") in bad
