@@ -1,0 +1,105 @@
+"""BASELINE.md configs 2-5 on the 8-device virtual CPU mesh.
+
+Every BASELINE.md config row gets a MEASURED rounds/sec through the real
+round program (bench.py child path: device-side sampling, vmapped local
+training, in-graph attack + aggregation, server step) — at CPU-feasible
+population sizes, with the platform and reduced K labeled in every row.
+These rows prove each config's full pipeline end to end and give the
+harness a number in the tunnel-down world; they are NOT comparable to TPU
+rounds/sec (no MXU, no HBM). The TPU-scale rows for the same configs are
+produced by scripts/tpu_capture.py (K ladder per config) in any tunnel-up
+window -> results/tpu_r5/rows.jsonl.
+
+Reference workload definitions: /root/reference/scripts/cifar10.py:24-62,
+scripts/main.py:17-57. Output: results/baseline_cpu/rows.jsonl +
+results/baseline_cpu/README.md.
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "results", "baseline_cpu")
+os.makedirs(OUT, exist_ok=True)
+ROWS = os.path.join(OUT, "rows.jsonl")
+
+COMMON = {
+    "BENCH_CHILD": 1,
+    "BENCH_FORCE_CPU": 1,
+    "BENCH_BF16": 0,  # CPU has no MXU; fp32 avoids slow bf16 emulation
+    "BENCH_WARMUP": 1,
+    "BENCH_TIMED": 2,
+    "BENCH_BATCH": 8,
+}
+
+
+def child_row(name, timeout=2400, **env):
+    full_env = dict(os.environ)
+    full_env.update({k: str(v) for k, v in {**COMMON, **env}.items()})
+    print(f"[baseline_cpu] {name}: {env}", flush=True)
+    row = {"name": name, "env": {k: str(v) for k, v in env.items()}}
+    try:
+        p = subprocess.run(
+            [sys.executable, "bench.py"], cwd=REPO, env=full_env,
+            capture_output=True, text=True, timeout=timeout,
+        )
+        for line in p.stdout.splitlines():
+            if line.startswith("BENCH_CHILD_RESULT "):
+                row.update(json.loads(line[len("BENCH_CHILD_RESULT "):]))
+        if "rounds_per_sec" not in row and "error" not in row:
+            row["error"] = (p.stderr or "no result line")[-300:]
+    except subprocess.TimeoutExpired:
+        row["error"] = f"timeout after {timeout}s"
+    row["date"] = datetime.datetime.utcnow().isoformat()
+    with open(ROWS, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(f"[baseline_cpu] {name} -> "
+          f"{row.get('rounds_per_sec', row.get('error'))}", flush=True)
+    return row
+
+
+def main():
+    if os.path.exists(ROWS):
+        os.unlink(ROWS)
+    # config 2: ResNet-18 fedsgd, no attack + mean (BASELINE row: K=100)
+    child_row("config2_resnet18_fedsgd_mean_cpuK8",
+              BENCH_MODEL="resnet18", BENCH_CLIENTS=8, BENCH_CHUNKS=1,
+              BENCH_AGG="mean")
+    # config 3: ResNet-18 fedavg (5 local steps, client Adam), IPM + Krum,
+    # 20% byzantine (BASELINE row: K=100)
+    child_row("config3_resnet18_fedavg_ipm_krum_cpuK8",
+              BENCH_MODEL="resnet18", BENCH_CLIENTS=8, BENCH_CHUNKS=1,
+              BENCH_AGG="krum", BENCH_ATTACK="ipm", BENCH_NUM_BYZ=2,
+              BENCH_CLIENT_OPT="adam", BENCH_LOCAL_STEPS=5)
+    # config 4: ResNet-18 fedsgd, signflipping + median / geomed
+    # (BASELINE row: K=1000 — HBM-infeasible on one v5e chip, see
+    # docs/performance.md feasibility bound; TPU K-ladder in tpu_capture)
+    child_row("config4_resnet18_signflip_median_cpuK8",
+              BENCH_MODEL="resnet18", BENCH_CLIENTS=8, BENCH_CHUNKS=1,
+              BENCH_AGG="median", BENCH_ATTACK="signflipping",
+              BENCH_NUM_BYZ=2)
+    child_row("config4_resnet18_signflip_geomed_cpuK8",
+              BENCH_MODEL="resnet18", BENCH_CLIENTS=8, BENCH_CHUNKS=1,
+              BENCH_AGG="geomed", BENCH_ATTACK="signflipping",
+              BENCH_NUM_BYZ=2)
+    # config 5: WRN-28-10 (D~36.5M), CIFAR-100 shapes, fedavg,
+    # labelflipping + clippedclustering / dnc (BASELINE row: K=1000)
+    child_row("config5_wrn_labelflip_clippedclustering_cpuK4",
+              BENCH_MODEL="wrn_28_10", BENCH_NUM_CLASSES=100,
+              BENCH_CLIENTS=4, BENCH_CHUNKS=1, BENCH_BATCH=4,
+              BENCH_AGG="clippedclustering", BENCH_ATTACK="labelflipping",
+              BENCH_NUM_BYZ=1, BENCH_CLIENT_OPT="adam",
+              BENCH_LOCAL_STEPS=2)
+    child_row("config5_wrn_labelflip_dnc_cpuK4",
+              BENCH_MODEL="wrn_28_10", BENCH_NUM_CLASSES=100,
+              BENCH_CLIENTS=4, BENCH_CHUNKS=1, BENCH_BATCH=4,
+              BENCH_AGG="dnc", BENCH_ATTACK="labelflipping",
+              BENCH_NUM_BYZ=1, BENCH_CLIENT_OPT="adam",
+              BENCH_LOCAL_STEPS=2)
+    print("[baseline_cpu] done ->", ROWS, flush=True)
+
+
+if __name__ == "__main__":
+    main()

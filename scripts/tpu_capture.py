@@ -1,12 +1,16 @@
-"""Round-4 TPU evidence capture: run everything VERDICT asked for in one
+"""Round-5 TPU evidence capture: run everything VERDICT asked for in one
 tunnel-up window, most valuable first (the tunnel dies without warning).
 
 Captures, in order:
-  1. headline bench (parent ladder, official JSON) -> results/tpu_r4/headline.json
+  1. headline bench (parent ladder, official JSON incl. the new
+     tflops_sustained/mfu fields) -> results/tpu_r5/headline.json
      and refreshes results/bench_tpu.json (the prior-capture carry)
-  2. jax.profiler trace of the headline round  -> results/tpu_r4/profile/
-  3. BASELINE.md configs 2-5 rows              -> results/tpu_r4/rows.jsonl
-  4. stage timings for the MFU accounting      -> results/tpu_r4/stages.json
+  2. jax.profiler trace of the headline round  -> results/tpu_r5/profile/
+  3. BASELINE.md configs 2-5 rows              -> results/tpu_r5/rows.jsonl
+  3b. perf-lever sweep: chunks 1/2, remat off at chunks 4/10/20, Pallas
+      trimmed-mean off, fp32 — the queued levers behind the 8.7-of-49
+      TFLOPS gap (VERDICT r4 weak #2)
+  4. stage timings for the MFU accounting      -> results/tpu_r5/stages.json
 
 Each measurement is a fresh subprocess with a timeout: TPU "Unavailable"
 errors poison the owning process, and one dead row must not kill the rest.
@@ -19,7 +23,7 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "results", "tpu_r4")
+OUT = os.path.join(REPO, "results", "tpu_r5")
 os.makedirs(OUT, exist_ok=True)
 ROWS = os.path.join(OUT, "rows.jsonl")
 
@@ -135,9 +139,17 @@ def main():
     # promoted to the default in a follow-up commit
     child_row("lever_chunks1", BENCH_CHUNKS=1, BENCH_WARMUP=2, BENCH_TIMED=6)
     child_row("lever_chunks2", BENCH_CHUNKS=2, BENCH_WARMUP=2, BENCH_TIMED=6)
+    child_row("lever_noremat_chunks4", BENCH_REMAT=0, BENCH_CHUNKS=4,
+              BENCH_WARMUP=2, BENCH_TIMED=6)
     child_row("lever_noremat_chunks10", BENCH_REMAT=0, BENCH_CHUNKS=10,
               BENCH_WARMUP=2, BENCH_TIMED=6)
     child_row("lever_noremat_chunks20", BENCH_REMAT=0, BENCH_CHUNKS=20,
+              BENCH_WARMUP=2, BENCH_TIMED=6)
+    # isolate the Pallas trimmed-mean kernel's contribution vs plain-XLA
+    # extraction, and the bf16 MXU path vs pure fp32
+    child_row("lever_nopallas_chunks4", BLADES_TPU_NO_PALLAS=1,
+              BENCH_CHUNKS=4, BENCH_WARMUP=2, BENCH_TIMED=6)
+    child_row("lever_fp32_chunks4", BENCH_BF16=0, BENCH_CHUNKS=4,
               BENCH_WARMUP=2, BENCH_TIMED=6)
 
     # --- 4. stage timings --------------------------------------------------

@@ -1,5 +1,5 @@
 #!/bin/bash
-# Poll the TPU tunnel; on the first up-window, run the full round-4 evidence
+# Poll the TPU tunnel; on the first up-window, run the full round-5 evidence
 # capture (scripts/tpu_capture.py). The tunnel dies for hours at a time, so
 # this runs in a tmux session from the start of the round.
 cd /root/repo
