@@ -226,6 +226,10 @@ def child_main() -> None:
             plan=plan,
             client_chunks=chunks,
             remat=remat,
+            # nothing reads last_updates here; keeping the [K, D] matrix
+            # out of the program outputs halves peak HBM at ladder scale
+            # (BENCH_KEEP_UPDATES=1 measures the cost of keeping it)
+            keep_updates=os.environ.get("BENCH_KEEP_UPDATES", "0") == "1",
         )
         state = engine.init(params)
         key = jax.random.PRNGKey(7)

@@ -143,6 +143,7 @@ class RoundEngine:
         plan: Optional[ShardingPlan] = None,
         client_chunks: int = 1,
         remat: bool = False,
+        keep_updates: bool = True,
     ):
         """``client_chunks``: split the K client axis into this many
         sequential chunks (``lax.map`` outside, vmap inside). Each chunk still
@@ -151,7 +152,18 @@ class RoundEngine:
         This is the HBM lever for large populations (K=1000 x CCT backward
         would otherwise materialize 32k-image activations). ``remat``
         additionally rematerializes each local step's forward during the
-        backward pass."""
+        backward pass.
+
+        ``keep_updates``: return the post-attack ``[K, D]`` update matrix
+        as a program OUTPUT so callers can read ``self.last_updates``
+        (observability: ``retain_updates``, ``on_round_end``, the
+        adjudication harness). As an output the matrix persists in HBM
+        across rounds — at ResNet-18 K=192 that is an extra ~8 GiB held
+        while the NEXT round computes its own matrix, roughly halving the
+        single-chip max K. ``False`` keeps the matrix internal to the XLA
+        program (aggregation still consumes it in-graph) and sets
+        ``last_updates`` to ``None``; bench.py uses this for the headline
+        and the K-ladder."""
         self.train_loss_fn = train_loss_fn
         self.eval_logits_fn = eval_logits_fn
         self.num_clients = int(num_clients)
@@ -170,6 +182,7 @@ class RoundEngine:
                 f"client_chunks {client_chunks}"
             )
         self.remat = bool(remat)
+        self.keep_updates = bool(keep_updates)
 
         self.dim, self.unravel = make_unraveler(params_template)
         # Reference convention: the FIRST num_byzantine client ids are
@@ -402,7 +415,9 @@ class RoundEngine:
             attack_state=attack_state,
             round_idx=state.round_idx + 1,
         )
-        return new_state, metrics, updates
+        # static branch: when the caller never reads the matrix, don't make
+        # it a program output (outputs persist in HBM across rounds)
+        return new_state, metrics, updates if self.keep_updates else ()
 
     def run_round(
         self,
@@ -417,7 +432,8 @@ class RoundEngine:
 
         The post-attack ``[K, D]`` update matrix of the round stays available
         as ``self.last_updates`` (device-resident; only materialized on host
-        if the caller reads it)."""
+        if the caller reads it) when the engine was built with
+        ``keep_updates=True`` (default); ``None`` otherwise."""
         new_state, metrics, updates = self._round_jit(
             state,
             cx,
@@ -426,7 +442,7 @@ class RoundEngine:
             jnp.asarray(server_lr, jnp.float32),
             key,
         )
-        self.last_updates = updates
+        self.last_updates = updates if self.keep_updates else None
         return new_state, metrics
 
     # -- evaluation ----------------------------------------------------------

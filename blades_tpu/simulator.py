@@ -338,6 +338,12 @@ class Simulator:
             plan=self.plan,
             client_chunks=client_chunks,
             remat=remat,
+            # the [K, D] matrix only needs to be a program output when
+            # someone will read it back (client update views / the
+            # on_round_end observability hook, which documents
+            # engine.last_updates); otherwise keep it in-graph — an output
+            # persists in HBM across rounds
+            keep_updates=retain_updates or on_round_end is not None,
         )
         state = self.engine.init(params)
 

@@ -1,4 +1,4 @@
-"""BASELINE.md configs 2-5 on the 8-device virtual CPU mesh.
+"""BASELINE.md configs 2-5 measured on a single virtual CPU device.
 
 Every BASELINE.md config row gets a MEASURED rounds/sec through the real
 round program (bench.py child path: device-side sampling, vmapped local
@@ -28,15 +28,29 @@ ROWS = os.path.join(OUT, "rows.jsonl")
 COMMON = {
     "BENCH_CHILD": 1,
     "BENCH_FORCE_CPU": 1,
+    # ONE virtual device: the goal of these rows is the measured config
+    # pipeline, not the sharding proof (that's tests/test_distributed.py
+    # and dryrun_multichip). XLA's SPMD partitioner on the 8-device CPU
+    # mesh takes >40 min to compile the vmapped ResNet round — measured,
+    # config2 timed out at 2400s — while the unpartitioned program
+    # compiles in minutes.
+    "BENCH_CPU_DEVICES": 1,
+    "BENCH_REMAT": 0,  # remat doubles the compiled graph; pointless on CPU
     "BENCH_BF16": 0,  # CPU has no MXU; fp32 avoids slow bf16 emulation
     "BENCH_WARMUP": 1,
     "BENCH_TIMED": 2,
-    "BENCH_BATCH": 8,
+    "BENCH_BATCH": 4,
 }
 
 
 def child_row(name, timeout=2400, **env):
     full_env = dict(os.environ)
+    # a launcher-provided XLA_FLAGS (e.g. the 8-device CPU-mesh recipe from
+    # CLAUDE.md) would win over BENCH_CPU_DEVICES: force_virtual_cpu only
+    # appends flags not already present, so the child would silently compile
+    # the 8-device SPMD program again — the measured >40-min compile this
+    # script exists to avoid
+    full_env.pop("XLA_FLAGS", None)
     full_env.update({k: str(v) for k, v in {**COMMON, **env}.items()})
     print(f"[baseline_cpu] {name}: {env}", flush=True)
     row = {"name": name, "env": {k: str(v) for k, v in env.items()}}
@@ -64,37 +78,37 @@ def main():
     if os.path.exists(ROWS):
         os.unlink(ROWS)
     # config 2: ResNet-18 fedsgd, no attack + mean (BASELINE row: K=100)
-    child_row("config2_resnet18_fedsgd_mean_cpuK8",
-              BENCH_MODEL="resnet18", BENCH_CLIENTS=8, BENCH_CHUNKS=1,
+    child_row("config2_resnet18_fedsgd_mean_cpuK4",
+              BENCH_MODEL="resnet18", BENCH_CLIENTS=4, BENCH_CHUNKS=1,
               BENCH_AGG="mean")
     # config 3: ResNet-18 fedavg (5 local steps, client Adam), IPM + Krum,
     # 20% byzantine (BASELINE row: K=100)
-    child_row("config3_resnet18_fedavg_ipm_krum_cpuK8",
-              BENCH_MODEL="resnet18", BENCH_CLIENTS=8, BENCH_CHUNKS=1,
-              BENCH_AGG="krum", BENCH_ATTACK="ipm", BENCH_NUM_BYZ=2,
+    child_row("config3_resnet18_fedavg_ipm_krum_cpuK4",
+              BENCH_MODEL="resnet18", BENCH_CLIENTS=4, BENCH_CHUNKS=1,
+              BENCH_AGG="krum", BENCH_ATTACK="ipm", BENCH_NUM_BYZ=1,
               BENCH_CLIENT_OPT="adam", BENCH_LOCAL_STEPS=5)
     # config 4: ResNet-18 fedsgd, signflipping + median / geomed
     # (BASELINE row: K=1000 — HBM-infeasible on one v5e chip, see
     # docs/performance.md feasibility bound; TPU K-ladder in tpu_capture)
-    child_row("config4_resnet18_signflip_median_cpuK8",
-              BENCH_MODEL="resnet18", BENCH_CLIENTS=8, BENCH_CHUNKS=1,
+    child_row("config4_resnet18_signflip_median_cpuK4",
+              BENCH_MODEL="resnet18", BENCH_CLIENTS=4, BENCH_CHUNKS=1,
               BENCH_AGG="median", BENCH_ATTACK="signflipping",
-              BENCH_NUM_BYZ=2)
-    child_row("config4_resnet18_signflip_geomed_cpuK8",
-              BENCH_MODEL="resnet18", BENCH_CLIENTS=8, BENCH_CHUNKS=1,
+              BENCH_NUM_BYZ=1)
+    child_row("config4_resnet18_signflip_geomed_cpuK4",
+              BENCH_MODEL="resnet18", BENCH_CLIENTS=4, BENCH_CHUNKS=1,
               BENCH_AGG="geomed", BENCH_ATTACK="signflipping",
-              BENCH_NUM_BYZ=2)
+              BENCH_NUM_BYZ=1)
     # config 5: WRN-28-10 (D~36.5M), CIFAR-100 shapes, fedavg,
     # labelflipping + clippedclustering / dnc (BASELINE row: K=1000)
-    child_row("config5_wrn_labelflip_clippedclustering_cpuK4",
+    child_row("config5_wrn_labelflip_clippedclustering_cpuK2",
               BENCH_MODEL="wrn_28_10", BENCH_NUM_CLASSES=100,
-              BENCH_CLIENTS=4, BENCH_CHUNKS=1, BENCH_BATCH=4,
+              BENCH_CLIENTS=2, BENCH_CHUNKS=1, BENCH_BATCH=2,
               BENCH_AGG="clippedclustering", BENCH_ATTACK="labelflipping",
               BENCH_NUM_BYZ=1, BENCH_CLIENT_OPT="adam",
               BENCH_LOCAL_STEPS=2)
-    child_row("config5_wrn_labelflip_dnc_cpuK4",
+    child_row("config5_wrn_labelflip_dnc_cpuK2",
               BENCH_MODEL="wrn_28_10", BENCH_NUM_CLASSES=100,
-              BENCH_CLIENTS=4, BENCH_CHUNKS=1, BENCH_BATCH=4,
+              BENCH_CLIENTS=2, BENCH_CHUNKS=1, BENCH_BATCH=2,
               BENCH_AGG="dnc", BENCH_ATTACK="labelflipping",
               BENCH_NUM_BYZ=1, BENCH_CLIENT_OPT="adam",
               BENCH_LOCAL_STEPS=2)

@@ -151,6 +151,10 @@ def main():
               BENCH_CHUNKS=4, BENCH_WARMUP=2, BENCH_TIMED=6)
     child_row("lever_fp32_chunks4", BENCH_BF16=0, BENCH_CHUNKS=4,
               BENCH_WARMUP=2, BENCH_TIMED=6)
+    # cost of materializing the [K, D] matrix as a program output (the
+    # r4-and-earlier headline always paid this; r5 default is off)
+    child_row("lever_keepupdates_chunks4", BENCH_KEEP_UPDATES=1,
+              BENCH_CHUNKS=4, BENCH_WARMUP=2, BENCH_TIMED=6)
 
     # --- 4. stage timings --------------------------------------------------
     log("stage timings")

@@ -261,3 +261,44 @@ def test_eval_padded_tail(params, ds):
     ev = eng.evaluate(st, ds.test_x[:70], ds.test_y[:70], batch_size=32)
     assert 0.0 <= ev["top1"] <= 1.0
     assert np.isfinite(ev["Loss"])
+
+
+def test_keep_updates_off_matches_and_drops_output():
+    """keep_updates=False must produce the bit-identical round (same state,
+    same metrics — the matrix is still consumed in-graph by aggregation)
+    while last_updates becomes None instead of a [K, D] output buffer."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from blades_tpu.aggregators import get_aggregator
+    from blades_tpu.attackers import get_attack
+    from blades_tpu.core import RoundEngine
+
+    def loss_fn(params, x, y, key):
+        logits = x.reshape(x.shape[0], -1) @ params["w"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, y[:, None], axis=1).mean(), {}
+
+    rng = np.random.RandomState(0)
+    W0 = {"w": jnp.asarray(rng.randn(12, 4).astype(np.float32))}
+    cx = jnp.asarray(rng.randn(6, 1, 8, 12).astype(np.float32))
+    cy = jnp.asarray(rng.randint(0, 4, (6, 1, 8)).astype(np.int32))
+
+    outs = {}
+    for keep in (True, False):
+        eng = RoundEngine(
+            loss_fn, lambda p, x: x.reshape(x.shape[0], -1) @ p["w"], W0,
+            num_clients=6, num_byzantine=2, attack=get_attack("ipm"),
+            aggregator=get_aggregator("trimmedmean", num_byzantine=2),
+            num_classes=4, keep_updates=keep,
+        )
+        state = eng.init(W0)
+        state, m = eng.run_round(state, cx, cy, 0.1, 1.0, jax.random.PRNGKey(5))
+        outs[keep] = (np.asarray(state.params["w"]), float(m.train_loss),
+                      eng.last_updates)
+
+    np.testing.assert_array_equal(outs[True][0], outs[False][0])
+    assert outs[True][1] == outs[False][1]
+    assert outs[True][2] is not None and outs[True][2].shape == (6, 48)
+    assert outs[False][2] is None
