@@ -15,19 +15,24 @@ round the actual post-attack ``[K, D]`` update matrix is fed to
    ``/root/reference/src/blades/aggregators/krum.py`` (torch),
 
 recording each stack's selected client row. The committed result
-(``results/fedavg_ipm/adjudication.json``): the reference-parity stack and
-the reference's own Krum select the SAME row in all 30 rounds (agreement
-1.0, max aggregate diff 0.0), and Krum is byzantine-captured for the first
-11 consecutive rounds (14/30 overall) — long enough to wreck the model;
-the later honest selections are single-client Adam updates that cannot
-recover it. The collapse is a property of Krum-vs-IPM, not of this
-implementation. Mechanism: the 8 IPM rows are
-bit-identical (every byzantine uploads ``-eps * mean(honest)``), so they
-give each other pairwise distance 0 and win the sum-of-nearest-neighbors
-score every round; the server then applies ``-0.5 * mean(honest)`` — a
-*reversed* half-step of gradient ascent — every round, which diverges. Mean,
-by contrast, still moves in expectation by ``(12 - 8*0.5)/20 = +0.4x`` the
-honest direction, so the undefended run trains through the attack.
+(``results/fedavg_ipm/adjudication.json``): the reference-parity stack
+(d^4) and the reference's own Krum select the SAME row in all 30 rounds
+(agreement 1.0, max aggregate diff 0.0). The production d^2 default
+agrees with that pair on 22/30 rounds; on the other 8 (rounds 7-11, 27,
+29-30) the two scorings rank differently and d^2 selects one of the
+bit-identical IPM rows while d^4 picks an honest one — so d^2 is
+byzantine-captured for the first 11 consecutive rounds (14/30 overall),
+the d^4/reference pair for the first 6. Either capture streak wrecks the
+model, and the later honest selections are single-client Adam updates
+that cannot recover it: the collapse is a property of Krum-vs-IPM, not
+of this implementation. Mechanism: the 8 IPM rows are bit-identical
+(every byzantine uploads ``-eps * mean(honest)``), so they give each
+other pairwise distance 0 and win the sum-of-nearest-neighbors score
+whenever the honest updates still carry strong, varied gradient signal —
+every captured round applies ``-0.5 * mean(honest)``, a *reversed*
+half-step of gradient ascent, which diverges. Mean, by contrast, still
+moves in expectation by ``(12 - 8*0.5)/20 = +0.4x`` the honest
+direction, so the undefended run trains through the attack.
 
 Reference counterparts: ``attackers/ipmclient.py:4-16``,
 ``aggregators/krum.py:93-125``.

@@ -244,6 +244,25 @@ def main() -> None:
                 },
                 f, indent=1,
             )
+        # BASELINE.md's third per-config metric ("attack success = accuracy
+        # degradation vs no-attack run"): the attacked cell's top-1 drop
+        # against the same defense's unattacked cell, positive = the attack
+        # cost accuracy
+        success = {
+            a: {g: round(matrix["none"][g] - matrix[a][g], 4) for g in AGGS}
+            for a in ATTACKS if a != "none" and a in matrix
+        }
+        with open(os.path.join(args.out, "attack_success.json"), "w") as f:
+            json.dump(
+                {
+                    "definition": "delta_top1[attack][agg] = top1(none, agg)"
+                                  " - top1(attack, agg); positive = attack"
+                                  " succeeded by that many points",
+                    "rounds": matrix["_rounds"],
+                    "delta_top1": success,
+                },
+                f, indent=1,
+            )
         bad = [r for r in rows if not r["ok"]]
         print(f"expectations: {len(rows) - len(bad)}/{len(rows)} ok")
         for r in bad:

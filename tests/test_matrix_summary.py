@@ -77,3 +77,22 @@ def test_gate_detects_neutered_alie(matrix):
     bad = {(r["attack"], r["agg"]) for r in rows if not r["ok"]}
     assert ("alie", "median") in bad
     assert ("alie", "trimmedmean") in bad
+
+
+def test_attack_success_artifact_in_sync(matrix):
+    """results/matrix/attack_success.json (BASELINE's 'attack success'
+    metric: top-1 degradation vs the same defense unattacked) must be
+    derivable from the committed matrix."""
+    from examples.robustness_matrix import AGGS, ATTACKS
+
+    path = os.path.join(REPO, "results", "matrix", "attack_success.json")
+    assert os.path.exists(path), "regenerate via examples/robustness_matrix.py"
+    with open(path) as f:
+        success = json.load(f)
+    assert success["rounds"] == matrix["_rounds"]
+    for a in ATTACKS:
+        if a == "none":
+            continue
+        for g in AGGS:
+            expect = round(matrix["none"][g] - matrix[a][g], 4)
+            assert success["delta_top1"][a][g] == pytest.approx(expect)

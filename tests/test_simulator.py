@@ -196,3 +196,19 @@ def test_bf16_run(tmp_path):
     sim = _sim(tmp_path, aggregator="mean")
     sim.run("mlp", global_rounds=2, local_steps=1, train_batch_size=8,
             validate_interval=2, compute_dtype="bfloat16")
+
+
+def test_updates_dropped_by_default_kept_when_consumed(tmp_path):
+    """The [K, D] matrix is only a program output when someone reads it:
+    default run() leaves last_updates None; retain_updates=True populates
+    it (engine.py keep_updates)."""
+    sim = _sim(tmp_path / "off")
+    sim.run("mlp", global_rounds=1, local_steps=1, train_batch_size=8,
+            validate_interval=1)
+    assert sim.engine.keep_updates is False
+    assert sim.engine.last_updates is None
+
+    sim2 = _sim(tmp_path / "on")
+    sim2.run("mlp", global_rounds=1, local_steps=1, train_batch_size=8,
+             validate_interval=1, retain_updates=True)
+    assert sim2.engine.last_updates is not None
