@@ -230,6 +230,9 @@ def child_main() -> None:
             # out of the program outputs halves peak HBM at ladder scale
             # (BENCH_KEEP_UPDATES=1 measures the cost of keeping it)
             keep_updates=os.environ.get("BENCH_KEEP_UPDATES", "0") == "1",
+            # every round samples fresh batches, so their buffers are safe
+            # to donate (~0.4 GB HBM back at the K=1000 headline)
+            donate_batches=os.environ.get("BENCH_DONATE_BATCHES", "1") == "1",
         )
         state = engine.init(params)
         key = jax.random.PRNGKey(7)

@@ -144,6 +144,7 @@ class RoundEngine:
         client_chunks: int = 1,
         remat: bool = False,
         keep_updates: bool = True,
+        donate_batches: bool = False,
     ):
         """``client_chunks``: split the K client axis into this many
         sequential chunks (``lax.map`` outside, vmap inside). Each chunk still
@@ -163,7 +164,15 @@ class RoundEngine:
         single-chip max K. ``False`` keeps the matrix internal to the XLA
         program (aggregation still consumes it in-graph) and sets
         ``last_updates`` to ``None``; bench.py uses this for the headline
-        and the K-ladder."""
+        and the K-ladder.
+
+        ``donate_batches``: additionally donate the ``cx``/``cy`` batch
+        buffers to the round program (fresh sampler outputs are dead after
+        the round; donation lets XLA alias their HBM — ~0.4 GB at the
+        K=1000 headline — for intermediates). Off by default because a
+        caller that reuses the same batch arrays across ``run_round``
+        calls (e.g. a fixed-batch microbenchmark) would hand XLA a
+        donated-and-consumed buffer."""
         self.train_loss_fn = train_loss_fn
         self.eval_logits_fn = eval_logits_fn
         self.num_clients = int(num_clients)
@@ -194,7 +203,8 @@ class RoundEngine:
 
         self._client_tx = client_opt.transform()
         self._server_tx = server_opt.transform()
-        self._round_jit = jax.jit(self._round, donate_argnums=(0,))
+        donate = (0, 1, 2) if donate_batches else (0,)
+        self._round_jit = jax.jit(self._round, donate_argnums=donate)
         self._eval_jit = jax.jit(self._eval_batch)
         self._eval_per_sample_jit = jax.jit(self._eval_batch_per_sample)
 

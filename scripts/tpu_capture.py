@@ -155,6 +155,9 @@ def main():
     # r4-and-earlier headline always paid this; r5 default is off)
     child_row("lever_keepupdates_chunks4", BENCH_KEEP_UPDATES=1,
               BENCH_CHUNKS=4, BENCH_WARMUP=2, BENCH_TIMED=6)
+    # batch-buffer donation off (r5 default is on)
+    child_row("lever_nodonate_chunks4", BENCH_DONATE_BATCHES=0,
+              BENCH_CHUNKS=4, BENCH_WARMUP=2, BENCH_TIMED=6)
 
     # --- 4. stage timings --------------------------------------------------
     log("stage timings")
