@@ -55,19 +55,27 @@ BUDGET_AGGS = {"trimmedmean", "krum", "dnc"}
 #             still measurably bites: top1 <= this column's "none" cell - d.
 #             Used where absolute floors are too loose to catch an
 #             attack-becomes-no-op regression (VERDICT r4 weak #5): ALIE's
-#             committed damage is -0.126 (median) / -0.119 (trimmedmean) at
-#             seed 1, so d=0.05 leaves seed room while a stubbed-out ALIE
-#             (attacked == unattacked) fails the cell. The other ALIE
-#             columns measured deltas within seed noise (mean +0.042,
-#             geomed/krum/dnc negative) — no relative bound is supportable
-#             there, so they keep absolute floors.
+#             measured damage on median/trimmedmean is -0.126/-0.119 at
+#             seed 1 and replicates at -0.165/-0.160 at seed 2
+#             (results/matrix_s2), so d=0.05 leaves seed room while a
+#             stubbed-out ALIE (attacked == unattacked) fails the cell.
+#             The other ALIE columns measured deltas within seed noise
+#             (mean +0.042/+0.056; geomed/krum/dnc sign-flip across seeds)
+#             — no relative bound is supportable there, so they keep
+#             absolute floors. Floors sit below the TWO-seed measured
+#             range but far above a broken defense (collapse ~0.10-0.25).
 EXPECTATIONS = {
     "none": {agg: ("min", 0.50) for agg in AGGS},
     "noise": {
         "mean": ("max", 0.30),
         **{a: ("min", 0.55) for a in
-           ("median", "trimmedmean", "geomed", "krum", "clippedclustering",
-            "dnc", "signguard")},
+           ("median", "trimmedmean", "clippedclustering", "dnc",
+            "signguard")},
+        # geomed/krum measured 0.545 at seed 2 (0.565/0.549 at seed 1) —
+        # floor set below the two-seed range, far above a broken defense
+        # (noise vs mean collapses to ~0.11)
+        "geomed": ("min", 0.52),
+        "krum": ("min", 0.52),
     },
     "labelflipping": {
         "mean": ("range", 0.25, 0.55),
@@ -91,8 +99,10 @@ EXPECTATIONS = {
     },
     "alie": {
         **{a: ("min", 0.50) for a in AGGS},
-        "median": ("band_rel", 0.50, 0.05),
-        "trimmedmean": ("band_rel", 0.50, 0.05),
+        "median": ("band_rel", 0.48, 0.05),
+        "trimmedmean": ("band_rel", 0.48, 0.05),
+        # 0.492 measured at seed 2 (0.563 at seed 1)
+        "clippedclustering": ("min", 0.47),
         "dnc": ("min", 0.65),
     },
     "ipm": {
@@ -137,7 +147,8 @@ def evaluate_expectations(matrix):
     return rows, ok_all
 
 
-def run_cell(ds, attack: str, agg: str, rounds: int, out_dir: str) -> float:
+def run_cell(ds, attack: str, agg: str, rounds: int, out_dir: str,
+             seed: int = 1) -> float:
     from blades_tpu import Simulator
     from blades_tpu.utils.logging import read_stats
 
@@ -149,7 +160,7 @@ def run_cell(ds, attack: str, agg: str, rounds: int, out_dir: str) -> float:
         num_byzantine=0 if attack == "none" else BYZ,
         attack=None if attack == "none" else attack,
         log_path=log_path,
-        seed=1,
+        seed=seed,
     )
     sim.run(
         model="mlp",
@@ -192,6 +203,9 @@ def plot(matrix, path: str) -> None:
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--rounds", type=int, default=20)
+    p.add_argument("--seed", type=int, default=1,
+                   help="training seed per cell (dataset partition stays "
+                        "seed-1 so cells differ only by trajectory)")
     p.add_argument("--out", default=os.path.join(REPO, "results", "matrix"))
     p.add_argument("--attacks", nargs="*", default=ATTACKS)
     p.add_argument("--aggs", nargs="*", default=AGGS)
@@ -210,19 +224,22 @@ def main() -> None:
         with open(matrix_path) as f:
             matrix = json.load(f)
         prev_rounds = matrix.get("_rounds")
-        if matrix and prev_rounds != args.rounds:
+        prev_seed = matrix.get("_seed", 1)
+        if matrix and (prev_rounds != args.rounds or prev_seed != args.seed):
             # an existing file without _rounds has unknown provenance —
             # refuse that too rather than mislabel mixed-rounds cells
             sys.exit(
-                f"refusing to merge --rounds {args.rounds} cells into a "
-                f"matrix recorded at {prev_rounds} rounds ({matrix_path}); "
-                "match --rounds or use a fresh --out dir"
+                f"refusing to merge --rounds {args.rounds} --seed "
+                f"{args.seed} cells into a matrix recorded at "
+                f"{prev_rounds} rounds, seed {prev_seed} ({matrix_path}); "
+                "match both or use a fresh --out dir"
             )
     matrix["_rounds"] = args.rounds
+    matrix["_seed"] = args.seed
     for attack in args.attacks:
         matrix.setdefault(attack, {})
         for agg in args.aggs:
-            top1 = run_cell(ds, attack, agg, args.rounds, args.out)
+            top1 = run_cell(ds, attack, agg, args.rounds, args.out, args.seed)
             matrix[attack][agg] = top1
             print(f"{attack:14s} x {agg:18s} -> top1 {top1:.3f}", flush=True)
 
@@ -236,6 +253,7 @@ def main() -> None:
             json.dump(
                 {
                     "rounds": matrix["_rounds"],
+                    "seed": matrix["_seed"],
                     # every krum cell uses the d^2 paper default; the
                     # reference-compat d^4 ranking is Krum(distance_power=4)
                     "krum_variant": "distance_power=2 (paper default)",
@@ -259,6 +277,7 @@ def main() -> None:
                                   " - top1(attack, agg); positive = attack"
                                   " succeeded by that many points",
                     "rounds": matrix["_rounds"],
+                    "seed": matrix["_seed"],
                     "delta_top1": success,
                 },
                 f, indent=1,

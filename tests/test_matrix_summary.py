@@ -96,3 +96,25 @@ def test_attack_success_artifact_in_sync(matrix):
         for g in AGGS:
             expect = round(matrix["none"][g] - matrix[a][g], 4)
             assert success["delta_top1"][a][g] == pytest.approx(expect)
+
+
+def test_seed2_replication_passes_gate():
+    """The seed-2 rerun (results/matrix_s2) must satisfy the same
+    expectation table — the gate's floors are set below the TWO-seed
+    measured range — and must replicate the ALIE band_rel damage that
+    justifies the relative rule."""
+    from examples.robustness_matrix import evaluate_expectations
+
+    path = os.path.join(REPO, "results", "matrix_s2", "matrix.json")
+    if not os.path.exists(path):
+        pytest.skip("no committed seed-2 matrix")
+    with open(path) as f:
+        m = json.load(f)
+    assert m["_seed"] == 2
+    rows, ok = evaluate_expectations(m)
+    assert ok, [r for r in rows if not r["ok"]]
+    with open(os.path.join(REPO, "results", "matrix_s2", "summary.json")) as f:
+        s = json.load(f)
+    assert s["all_ok"] and s["seed"] == 2
+    for g in ("median", "trimmedmean"):
+        assert m["none"][g] - m["alie"][g] >= 0.05
