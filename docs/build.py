@@ -50,6 +50,10 @@ GALLERY = [
     ("mini_example.py", ["--synthetic"],
      {"MINI_ROUNDS": "5", "MINI_STEPS": "10"}, 600),
     ("customize_attack.py", ["--synthetic"], {}, 600),
+    ("customize_aggregator.py", [],
+     {"CA_ROUNDS": "4", "CA_STEPS": "5", "CA_OUT": "@TMP@"}, 600),
+    ("fltrust_example.py", [],
+     {"FT_ROUNDS": "5", "FT_STEPS": "5", "FT_OUT": "@TMP@"}, 600),
     ("convergence_config1.py",
      ["--rounds", "10", "--out", "@TMP@", "--plot", "@TMP@/config1.png"],
      {}, 900),

@@ -1,0 +1,94 @@
+"""
+Customization of aggregation scheme
+===================================
+
+Reference intent: ``src/blades/examples/todo_customize_aggregator.py`` (an
+unfinished stub upstream; the accepted surfaces are the callable path in
+``simulator.py:110-116`` and subclassing ``_BaseAggregator``,
+``aggregators/mean.py:9-40``). This framework accepts both, working:
+
+1. a **bare callable** ``[K, D] updates -> [D] aggregate`` — wrapped
+   automatically, traced into the jitted round program;
+2. an :class:`blades_tpu.aggregators.Aggregator` **subclass** — full
+   control, including explicit cross-round state (the jit-compatible
+   replacement for the reference's mutable-``self`` aggregators).
+
+Both are demonstrated against 4/12 IPM attackers and compared to plain
+mean. The subclass implements a norm-capped mean: each update's L2 norm is
+clipped to a running median of past round norms (a simplified
+centered-clipping flavor with real state threading).
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from blades_tpu.utils.platform import apply_env_platform  # noqa: E402
+
+apply_env_platform()  # honor JAX_PLATFORMS=cpu launchers (docs/build.py)
+
+import jax.numpy as jnp  # noqa: E402
+
+from blades_tpu.aggregators.base import Aggregator  # noqa: E402
+from blades_tpu.datasets import Synthetic  # noqa: E402
+from blades_tpu.simulator import Simulator  # noqa: E402
+from blades_tpu.utils.logging import read_stats  # noqa: E402
+
+ROUNDS = int(os.environ.get("CA_ROUNDS", 20))
+STEPS = int(os.environ.get("CA_STEPS", 10))
+
+
+def trimmed_like_callable(updates):
+    """Surface 1: a plain function. Coordinate-wise midhinge: mean of the
+    25th and 75th percentile per coordinate — cheap, outlier-resistant."""
+    lo = jnp.percentile(updates, 25, axis=0)
+    hi = jnp.percentile(updates, 75, axis=0)
+    return 0.5 * (lo + hi)
+
+
+class NormCappedMean(Aggregator):
+    """Surface 2: an Aggregator subclass with explicit cross-round state.
+
+    State = running estimate of the honest update norm; each round every
+    update is rescaled to at most that norm before averaging, then the
+    estimate moves toward this round's median norm. The state threading
+    (instead of mutating ``self``) is what lets the defense live inside
+    the compiled round program.
+    """
+
+    stateful = True
+
+    def init_state(self, num_clients, dim):
+        return jnp.asarray(1.0, jnp.float32)  # initial norm cap
+
+    def aggregate(self, updates, state=(), **ctx):
+        cap = state
+        norms = jnp.linalg.norm(updates, axis=1)
+        scale = jnp.minimum(1.0, cap / jnp.maximum(norms, 1e-12))
+        clipped = updates * scale[:, None]
+        new_cap = 0.7 * cap + 0.3 * jnp.median(norms)
+        return clipped.mean(axis=0), new_cap
+
+    def __repr__(self):
+        return "NormCappedMean"
+
+
+def run(agg, tag):
+    ds = Synthetic(num_clients=12, train_size=2400, test_size=480,
+                   noise=0.3, cache=False)
+    log = os.path.join(os.environ.get("CA_OUT", "./outputs"), f"ca_{tag}")
+    sim = Simulator(ds, num_byzantine=4, attack="ipm", aggregator=agg,
+                    log_path=log, seed=1)
+    sim.run(model="mlp", global_rounds=ROUNDS, local_steps=STEPS,
+            server_lr=1.0, client_lr=0.1, validate_interval=ROUNDS)
+    top1 = read_stats(log, type_filter="test")[-1]["top1"]
+    print(f"{tag:16s} final top-1 = {top1:.3f}")
+    return top1
+
+
+if __name__ == "__main__":
+    run("mean", "mean")
+    run(trimmed_like_callable, "callable")
+    run(NormCappedMean(), "subclass")

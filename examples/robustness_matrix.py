@@ -60,10 +60,11 @@ BUDGET_AGGS = {"trimmedmean", "krum", "dnc"}
 #             (results/matrix_s2), so d=0.05 leaves seed room while a
 #             stubbed-out ALIE (attacked == unattacked) fails the cell.
 #             The other ALIE columns measured deltas within seed noise
-#             (mean +0.042/+0.056; geomed/krum/dnc sign-flip across seeds)
-#             — no relative bound is supportable there, so they keep
-#             absolute floors. Floors sit below the TWO-seed measured
-#             range but far above a broken defense (collapse ~0.10-0.25).
+#             (mean +0.042/+0.056; geomed/krum sign-flip across seeds;
+#             dnc negative at both, -0.025/-0.011) — no relative bound is
+#             supportable there, so they keep absolute floors. Floors sit
+#             below the TWO-seed measured range but far above a broken
+#             defense (collapse ~0.10-0.25).
 EXPECTATIONS = {
     "none": {agg: ("min", 0.50) for agg in AGGS},
     "noise": {
@@ -71,9 +72,9 @@ EXPECTATIONS = {
         **{a: ("min", 0.55) for a in
            ("median", "trimmedmean", "clippedclustering", "dnc",
             "signguard")},
-        # geomed/krum measured 0.545 at seed 2 (0.565/0.549 at seed 1) —
-        # floor set below the two-seed range, far above a broken defense
-        # (noise vs mean collapses to ~0.11)
+        # geomed/krum measured 0.545 at seed 2 (0.607 at seed 1) — floor
+        # set below the two-seed range [0.545, 0.607], far above a broken
+        # defense (noise vs mean collapses to ~0.11)
         "geomed": ("min", 0.52),
         "krum": ("min", 0.52),
     },

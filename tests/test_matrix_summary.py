@@ -57,6 +57,7 @@ def test_summary_in_sync(matrix):
     rows, ok = evaluate_expectations(matrix)
     assert summary["all_ok"] == ok
     assert summary["rounds"] == matrix["_rounds"]
+    assert summary["seed"] == matrix["_seed"]
     recorded = {(r["attack"], r["agg"]): r for r in summary["cells"]}
     for r in rows:
         rec = recorded[(r["attack"], r["agg"])]
