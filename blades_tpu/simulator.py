@@ -291,6 +291,7 @@ class Simulator:
         remat: bool = False,
         compute_dtype: Optional[str] = None,
         on_round_end: Optional[Callable] = None,
+        donate_batches: bool = False,
     ) -> List[float]:
         """Run adversarial training; returns per-round wall times (reference
         ``run`` contract, ``simulator.py:364-457``).
@@ -306,6 +307,10 @@ class Simulator:
         ``client_chunks``/``remat``: HBM control for large populations (see
         RoundEngine). ``compute_dtype``: ``'bfloat16'`` for mixed-precision
         forward/backward (master weights stay float32).
+        ``donate_batches``: donate each round's sampled batch buffers to
+        the round program (safe with the built-in datasets, whose jitted
+        sampler returns fresh arrays every round; leave off for a custom
+        dataset that caches and re-serves batch arrays).
         """
         from blades_tpu.utils.xla_cache import enable_compilation_cache
 
@@ -344,6 +349,7 @@ class Simulator:
             # engine.last_updates); otherwise keep it in-graph — an output
             # persists in HBM across rounds
             keep_updates=retain_updates or on_round_end is not None,
+            donate_batches=donate_batches,
         )
         state = self.engine.init(params)
 

@@ -212,3 +212,19 @@ def test_updates_dropped_by_default_kept_when_consumed(tmp_path):
     sim2.run("mlp", global_rounds=1, local_steps=1, train_batch_size=8,
              validate_interval=1, retain_updates=True)
     assert sim2.engine.last_updates is not None
+
+
+def test_run_with_donated_batches_matches(tmp_path):
+    """run(donate_batches=True) must produce the same training as the
+    default (built-in datasets sample fresh buffers every round, so
+    donation only changes buffer lifetime, not values)."""
+    sim_a = _sim(tmp_path / "a", seed=4)
+    sim_a.run("mlp", global_rounds=2, local_steps=1, train_batch_size=8,
+              validate_interval=2)
+    ev_a = sim_a.evaluate(2, 64)
+
+    sim_b = _sim(tmp_path / "b", seed=4)
+    sim_b.run("mlp", global_rounds=2, local_steps=1, train_batch_size=8,
+              validate_interval=2, donate_batches=True)
+    ev_b = sim_b.evaluate(2, 64)
+    assert ev_a == ev_b
