@@ -56,15 +56,17 @@ BUDGET_AGGS = {"trimmedmean", "krum", "dnc"}
 #             Used where absolute floors are too loose to catch an
 #             attack-becomes-no-op regression (VERDICT r4 weak #5): ALIE's
 #             measured damage on median/trimmedmean is -0.126/-0.119 at
-#             seed 1 and replicates at -0.165/-0.160 at seed 2
-#             (results/matrix_s2), so d=0.05 leaves seed room while a
+#             seed 1 and replicates at -0.165/-0.160 (seed 2) and
+#             -0.167/-0.161 (seed 3), so d=0.05 leaves seed room while a
 #             stubbed-out ALIE (attacked == unattacked) fails the cell.
 #             The other ALIE columns measured deltas within seed noise
-#             (mean +0.042/+0.056; geomed/krum sign-flip across seeds;
-#             dnc negative at both, -0.025/-0.011) — no relative bound is
+#             (mean ~+0.05; geomed/krum sign-flip across seeds; dnc
+#             slightly negative at every seed) — no relative bound is
 #             supportable there, so they keep absolute floors. Floors sit
-#             below the TWO-seed measured range but far above a broken
-#             defense (collapse ~0.10-0.25).
+#             below the THREE-seed measured range (seeds 1-3 committed as
+#             results/matrix{,_s2,_s3}) but far above a broken defense
+#             (collapse ~0.07-0.25): e.g. dnc's lowest cell across seeds
+#             is 0.612 (ipm, seed 3) vs its 0.58 floor.
 EXPECTATIONS = {
     "none": {agg: ("min", 0.50) for agg in AGGS},
     "noise": {
@@ -72,9 +74,9 @@ EXPECTATIONS = {
         **{a: ("min", 0.55) for a in
            ("median", "trimmedmean", "clippedclustering", "dnc",
             "signguard")},
-        # geomed/krum measured 0.545 at seed 2 (0.607 at seed 1) — floor
-        # set below the two-seed range [0.545, 0.607], far above a broken
-        # defense (noise vs mean collapses to ~0.11)
+        # geomed/krum measured [0.545, 0.607] across seeds 1-3 — floor
+        # below that range, far above a broken defense (noise vs mean
+        # collapses to ~0.09-0.11)
         "geomed": ("min", 0.52),
         "krum": ("min", 0.52),
     },
@@ -85,7 +87,7 @@ EXPECTATIONS = {
         "geomed": ("min", 0.50),
         "krum": ("min", 0.50),
         "clippedclustering": ("min", 0.50),
-        "dnc": ("min", 0.65),
+        "dnc": ("min", 0.58),
         "signguard": ("range", 0.35, 0.70),
     },
     "signflipping": {
@@ -96,15 +98,15 @@ EXPECTATIONS = {
         "geomed": ("min", 0.50),
         "krum": ("min", 0.50),
         "clippedclustering": ("min", 0.50),
-        "dnc": ("min", 0.65),
+        "dnc": ("min", 0.58),
     },
     "alie": {
         **{a: ("min", 0.50) for a in AGGS},
         "median": ("band_rel", 0.48, 0.05),
         "trimmedmean": ("band_rel", 0.48, 0.05),
-        # 0.492 measured at seed 2 (0.563 at seed 1)
+        # [0.492, 0.563] measured across seeds 1-3
         "clippedclustering": ("min", 0.47),
-        "dnc": ("min", 0.65),
+        "dnc": ("min", 0.58),
     },
     "ipm": {
         "mean": ("range", 0.10, 0.50),
@@ -114,7 +116,7 @@ EXPECTATIONS = {
         "krum": ("max", 0.20),
         "signguard": ("range", 0.25, 0.60),
         "clippedclustering": ("min", 0.50),
-        "dnc": ("min", 0.65),
+        "dnc": ("min", 0.58),
     },
 }
 

@@ -63,6 +63,9 @@ def test_summary_in_sync(matrix):
         rec = recorded[(r["attack"], r["agg"])]
         assert rec["top1"] == pytest.approx(r["top1"])
         assert rec["ok"] == r["ok"]
+        # the committed rule must be the CURRENT expectation — catches a
+        # re-tuned EXPECTATIONS table whose summary was not regenerated
+        assert rec["rule"] == r["rule"]
 
 
 def test_gate_detects_neutered_alie(matrix):
@@ -99,23 +102,24 @@ def test_attack_success_artifact_in_sync(matrix):
             assert success["delta_top1"][a][g] == pytest.approx(expect)
 
 
-def test_seed2_replication_passes_gate():
-    """The seed-2 rerun (results/matrix_s2) must satisfy the same
-    expectation table — the gate's floors are set below the TWO-seed
+@pytest.mark.parametrize("seed", [2, 3])
+def test_seed_replication_passes_gate(seed):
+    """The seed-2/3 reruns (results/matrix_s2, _s3) must satisfy the same
+    expectation table — the gate's floors are set below the THREE-seed
     measured range — and must replicate the ALIE band_rel damage that
     justifies the relative rule."""
     from examples.robustness_matrix import evaluate_expectations
 
-    path = os.path.join(REPO, "results", "matrix_s2", "matrix.json")
-    if not os.path.exists(path):
-        pytest.skip("no committed seed-2 matrix")
-    with open(path) as f:
+    d = os.path.join(REPO, "results", f"matrix_s{seed}")
+    if not os.path.exists(os.path.join(d, "matrix.json")):
+        pytest.skip(f"no committed seed-{seed} matrix")
+    with open(os.path.join(d, "matrix.json")) as f:
         m = json.load(f)
-    assert m["_seed"] == 2
+    assert m["_seed"] == seed
     rows, ok = evaluate_expectations(m)
     assert ok, [r for r in rows if not r["ok"]]
-    with open(os.path.join(REPO, "results", "matrix_s2", "summary.json")) as f:
+    with open(os.path.join(d, "summary.json")) as f:
         s = json.load(f)
-    assert s["all_ok"] and s["seed"] == 2
+    assert s["all_ok"] and s["seed"] == seed
     for g in ("median", "trimmedmean"):
         assert m["none"][g] - m["alie"][g] >= 0.05
