@@ -186,11 +186,35 @@ def test_round_deterministic(params, ds):
     np.testing.assert_array_equal(np.asarray(ravel(s1.params)), np.asarray(ravel(s2.params)))
 
 
-def test_sharded_matches_unsharded(params, ds):
+@pytest.mark.parametrize(
+    "agg,attack",
+    [
+        ("trimmedmean", None),
+        ("trimmedmean", "alie"),  # cross-client omniscient stats sharded
+        ("clippedclustering", None),
+        ("dnc", None),
+        ("geomed", None),
+        ("krum", None),
+        ("signguard", None),
+    ],
+)
+def test_sharded_matches_unsharded(params, ds, agg, attack):
+    """Sharding must not change the round's result — across the full
+    defense family (selection, clustering, spectral, sign-statistics) and
+    with a cross-client omniscient attack in-graph. This is the invariant
+    that makes single-device matrix artifacts comparable to mesh runs
+    (docs/convergence.md)."""
     cx, cy = ds.sample_round(jax.random.PRNGKey(1), 1, 8)
     plan = make_plan(make_mesh())  # 8 CPU devices from conftest
-    un = _engine(params, aggregator=get_aggregator("trimmedmean"))
-    sh = _engine(params, aggregator=get_aggregator("trimmedmean"), plan=plan)
+    agg_kws = {"num_byzantine": 2} if agg in ("krum", "trimmedmean", "dnc") else {}
+    atk_kws = {"num_clients": K, "num_byzantine": 3} if attack == "alie" else {}
+    kw = dict(
+        aggregator=get_aggregator(agg, **agg_kws),
+        attack=get_attack(attack, **atk_kws) if attack else None,
+        num_byzantine=3 if attack else 0,
+    )
+    un = _engine(params, **kw)
+    sh = _engine(params, plan=plan, **kw)
     s_un, m_un = un.run_round(un.init(params), cx, cy, 0.1, 1.0, jax.random.PRNGKey(2))
     s_sh, m_sh = sh.run_round(sh.init(params), cx, cy, 0.1, 1.0, jax.random.PRNGKey(2))
     np.testing.assert_allclose(

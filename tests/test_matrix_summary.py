@@ -121,5 +121,13 @@ def test_seed_replication_passes_gate(seed):
     with open(os.path.join(d, "summary.json")) as f:
         s = json.load(f)
     assert s["all_ok"] and s["seed"] == seed
+    # full per-cell sync incl. the rule fields — a re-tuned EXPECTATIONS
+    # table with a stale seed-N summary must fail here, not pass silently
+    recorded = {(r["attack"], r["agg"]): r for r in s["cells"]}
+    for r in rows:
+        rec = recorded[(r["attack"], r["agg"])]
+        assert rec["top1"] == pytest.approx(r["top1"])
+        assert rec["ok"] == r["ok"]
+        assert rec["rule"] == r["rule"]
     for g in ("median", "trimmedmean"):
         assert m["none"][g] - m["alie"][g] >= 0.05
