@@ -17,6 +17,11 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
+if os.environ.get("STAGE_FORCE_CPU") == "1":
+    from blades_tpu.utils.platform import force_virtual_cpu
+
+    force_virtual_cpu(int(os.environ.get("STAGE_CPU_DEVICES", 1)))
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,17 +1,38 @@
 #!/bin/bash
-# Poll the TPU tunnel; on the first up-window, run the full round-5 evidence
-# capture (scripts/tpu_capture.py). The tunnel dies for hours at a time, so
-# this runs in a tmux session from the start of the round.
+# Poll the TPU tunnel; on every up-window, (re-)run the round-5 evidence
+# capture (scripts/tpu_capture.py). The capture is RESUMABLE: it skips
+# artifacts previous windows already produced and exits 2 the moment the
+# tunnel dies, so short windows accumulate evidence instead of each one
+# needing to fit the whole sweep. Loop ends when the capture finishes
+# everything (exit 0) or the time budget runs out.
+#
+# Observed 2026-07-31: up-windows can be under a minute, hence the 150 s
+# poll cadence and 90 s probe timeout. This box has ONE CPU core — never
+# run pytest or other heavy jobs while this might be capturing.
 cd /root/repo
-for i in $(seq 1 130); do
-  if timeout 120 python -c "import jax; jax.jit(lambda x: x+1)(jax.numpy.zeros(4)).block_until_ready(); print('ALIVE', jax.devices()[0].platform)" 2>/dev/null | grep -q "ALIVE tpu"; then
+mkdir -p results/tpu_r5   # the >> redirection below must never fail
+BUDGET=${WATCH_BUDGET_S:-39600}   # ~11 h
+START=$SECONDS
+i=0
+while [ $((SECONDS - START)) -lt "$BUDGET" ]; do
+  i=$((i + 1))
+  # -k escalates to SIGKILL: a backend-init hang can ignore SIGTERM and a
+  # surviving probe would hold the single-chip lease for the whole budget.
+  # The probe itself is tpu_capture.tunnel_alive (one copy of the command
+  # and the accepted platform list); its inner subprocess timeout is 90 s.
+  if timeout -k 10 110 python scripts/tpu_capture.py --probe 2>/dev/null; then
     echo "TPU ALIVE at $(date -u), capturing..."
-    python scripts/tpu_capture.py 2>&1 | tee /tmp/tpu_capture.log
-    echo "WATCH DONE at $(date -u)"
-    exit 0
+    TUNNEL_PROBED=1 python scripts/tpu_capture.py >> results/tpu_r5/capture.log 2>&1
+    rc=$?
+    if [ $rc -eq 0 ]; then
+      echo "CAPTURE COMPLETE at $(date -u)"
+      exit 0
+    fi
+    echo "capture interrupted (rc=$rc) at $(date -u), resuming at next window"
+  else
+    echo "probe $i: tpu down at $(date -u)"
   fi
-  echo "probe $i: tpu down at $(date -u)"
-  sleep 300
+  sleep 150
 done
-echo "gave up after 130 probes"
+echo "budget exhausted after $i probes"
 exit 1
