@@ -163,20 +163,33 @@ def stages_section(lines):
                  f"chunks={s.get('chunks')}, D={s.get('D')})\n")
 
 
-def newest_trace():
+def _traces_newest_first():
     paths = glob.glob(os.path.join(OUT, "profile", "plugins", "profile",
                                    "*", "*.trace.json.gz"))
-    return max(paths, key=os.path.getmtime) if paths else None
+    return sorted(paths, key=os.path.getmtime, reverse=True)
 
 
 def trace_section(lines):
     lines.append("## Profiler trace: where device time goes\n")
-    path = newest_trace()
-    if not path:
+    paths = _traces_newest_first()
+    if not paths:
         lines.append("not captured yet\n")
         return
-    with gzip.open(path) as f:
-        t = json.load(f)
+    # a capture killed mid-export leaves a truncated gzip; fall back to the
+    # next-newest parseable trace instead of wedging the digest forever
+    t = path = None
+    for p in paths:
+        try:
+            with gzip.open(p) as f:
+                t = json.load(f)
+            path = p
+            break
+        except Exception as e:
+            lines.append(f"(skipping unreadable trace "
+                         f"`{os.path.relpath(p, REPO)}`: {e})")
+    if t is None:
+        lines.append("\nno parseable trace yet\n")
+        return
     ev = t.get("traceEvents", [])
     procs = {e["pid"]: e.get("args", {}).get("name", str(e["pid"]))
              for e in ev if e.get("ph") == "M"

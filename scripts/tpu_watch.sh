@@ -24,6 +24,23 @@ while [ $((SECONDS - START)) -lt "$BUDGET" ]; do
     echo "TPU ALIVE at $(date -u), capturing..."
     TUNNEL_PROBED=1 python scripts/tpu_capture.py >> results/tpu_r5/capture.log 2>&1
     rc=$?
+    # secure whatever this window produced: regenerate the digest and
+    # commit the evidence files (never the churning logs) so a late-round
+    # window still lands in git even if no one is at the keyboard
+    python scripts/analyze_tpu_r5.py > /dev/null 2>> results/tpu_r5/capture.log \
+      || echo "digest FAILED at $(date -u) — see capture.log"
+    # add per-file: one missing pathspec would make a combined git add
+    # abort without staging anything
+    for f in results/tpu_r5/headline.json results/tpu_r5/rows.jsonl \
+             results/tpu_r5/stages.json results/tpu_r5/analysis.md \
+             results/tpu_r5/profile results/bench_tpu.json; do
+      [ -e "$f" ] && git add "$f"
+    done
+    # pathspec-limit the commit: anything else staged in the shared index
+    # (an agent's half-finished work) must not ride along
+    git diff --cached --quiet -- results/ || \
+      git commit -q -m "Record TPU evidence from capture window ($(date -u +%H:%M) UTC)" \
+        -- results/tpu_r5 results/bench_tpu.json || true
     if [ $rc -eq 0 ]; then
       echo "CAPTURE COMPLETE at $(date -u)"
       exit 0
