@@ -25,12 +25,11 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "results", "tpu_r5")
-os.makedirs(OUT, exist_ok=True)
 ROWS = os.path.join(OUT, "rows.jsonl")
 
 
 def log(msg):
-    print(f"[capture {datetime.datetime.utcnow():%H:%M:%S}] {msg}", flush=True)
+    print(f"[capture {datetime.datetime.now(datetime.timezone.utc):%H:%M:%S}] {msg}", flush=True)
 
 
 def run(cmd, timeout, env=None):
@@ -222,7 +221,7 @@ def child_row(name, timeout=1500, **env):
     # from the give-up cap, then bail for the watcher to re-fire
     if not measured(row) and not row.get("oom") and not tunnel_alive():
         row["tunnel_died"] = True
-    row["date"] = datetime.datetime.utcnow().isoformat()
+    row["date"] = datetime.datetime.now(datetime.timezone.utc).isoformat()
     with open(ROWS, "a") as f:
         f.write(json.dumps(row) + "\n")
     log(f"row {name}: {row.get('rounds_per_sec', row.get('error'))}")
@@ -283,6 +282,10 @@ def _headline_done():
 
 
 def main():
+    # lazy so that importing this module (tests, --probe) never writes to
+    # the working tree
+    os.makedirs(OUT, exist_ok=True)
+
     # --- 1. headline through the official parent ladder -------------------
     if _headline_done():
         log("headline: already captured, skipping")
@@ -295,7 +298,7 @@ def main():
             headline = json.loads(line)
         except Exception:
             headline = {"error": (err or out)[-300:]}
-        headline["date"] = datetime.datetime.utcnow().isoformat()
+        headline["date"] = datetime.datetime.now(datetime.timezone.utc).isoformat()
         # a failed/off-TPU headline is never persisted as the result; the
         # failure is appended to HEAD_FAILS and retried at the next window
         # (the watcher re-fires within ~3 min while the tunnel is up) until

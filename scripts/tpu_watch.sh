@@ -29,23 +29,40 @@ while [ $((SECONDS - START)) -lt "$BUDGET" ]; do
     # window still lands in git even if no one is at the keyboard
     python scripts/analyze_tpu_r5.py > /dev/null 2>> results/tpu_r5/capture.log \
       || echo "digest FAILED at $(date -u) — see capture.log"
-    # add per-file: one missing pathspec would make a combined git add
-    # abort without staging anything
+    # one existence-checked list drives both the add and the commit
+    # pathspec: a path unknown to git would otherwise abort the whole
+    # pathspec-mode commit ("did not match any file(s) known to git"),
+    # and anything else staged in the shared index (an agent's
+    # half-finished work) must not ride along
+    evid=()
     for f in results/tpu_r5/headline.json results/tpu_r5/rows.jsonl \
              results/tpu_r5/stages.json results/tpu_r5/analysis.md \
              results/tpu_r5/profile results/bench_tpu.json; do
-      [ -e "$f" ] && git add "$f"
+      [ -e "$f" ] && evid+=("$f")
     done
-    # pathspec-limit the commit: anything else staged in the shared index
-    # (an agent's half-finished work) must not ride along
-    git diff --cached --quiet -- results/ || \
-      git commit -q -m "Record TPU evidence from capture window ($(date -u +%H:%M) UTC)" \
-        -- results/tpu_r5 results/bench_tpu.json || true
-    if [ $rc -eq 0 ]; then
+    committed=1
+    if [ ${#evid[@]} -gt 0 ]; then
+      git add -- "${evid[@]}" \
+        || echo "evidence git add FAILED at $(date -u) (index lock?)"
+      if ! git diff --cached --quiet -- results/; then
+        if git commit -q \
+             -m "Record TPU evidence from capture window ($(date -u +%H:%M) UTC)" \
+             -- "${evid[@]}"; then
+          echo "evidence committed at $(date -u): ${evid[*]}"
+        else
+          committed=0
+          echo "evidence commit FAILED at $(date -u); retrying next window"
+        fi
+      fi
+    fi
+    # exit only when the capture is complete AND its evidence is in git —
+    # a swallowed commit failure must not end the loop with work stranded
+    if [ $rc -eq 0 ] && [ $committed -eq 1 ] \
+       && [ -z "$(git status --porcelain -- "${evid[@]}" 2>/dev/null)" ]; then
       echo "CAPTURE COMPLETE at $(date -u)"
       exit 0
     fi
-    echo "capture interrupted (rc=$rc) at $(date -u), resuming at next window"
+    [ $rc -ne 0 ] && echo "capture interrupted (rc=$rc) at $(date -u), resuming at next window"
   else
     echo "probe $i: tpu down at $(date -u)"
   fi
