@@ -150,9 +150,14 @@ def child_main() -> None:
         import jax.numpy as jnp
         import numpy as np
 
+        from blades_tpu.telemetry import Recorder, set_recorder
         from blades_tpu.utils.xla_cache import enable_compilation_cache
 
-        enable_compilation_cache()
+        # memory-only recorder: the child wants compile/cache counters for
+        # the payload's telemetry sub-dict, not a trace file
+        telem = Recorder(enabled=True)
+        set_recorder(telem)
+        enable_compilation_cache()  # also installs the jax.monitoring hooks
 
         # pre-flight: a trivial jit proves the backend is up before we pay
         # for the big compile; retry because backend setup errors are
@@ -270,6 +275,42 @@ def child_main() -> None:
         if not np.isfinite(loss):
             raise RuntimeError(f"non-finite loss {loss}")
 
+        # snapshot compile/cache counters BEFORE the agg probe below: its
+        # own jit compile is not part of the round program's cold-start
+        # cost the telemetry fields account for
+        counters = telem.snapshot()["counters"]
+
+        # isolated aggregation cost on the exact [K, D] update-matrix shape
+        # (stage (c) of scripts/stage_timing.py, now carried by every bench
+        # run); best-effort — an aggregator needing extra ctx reports null
+        stage = "agg_timing"
+        agg_s = None
+        try:
+            u = jax.random.normal(
+                jax.random.fold_in(key, 999), (k, engine.dim), jnp.float32
+            )
+            agg_state = agg.init_state(k, engine.dim)
+            agg_jit = jax.jit(
+                lambda mtx, st, kk: agg.aggregate(mtx, st, key=kk)[0]
+            )
+            akey = jax.random.fold_in(key, 998)
+            jax.block_until_ready(agg_jit(u, agg_state, akey))  # warm
+            t0 = time.time()
+            for _ in range(5):
+                out = agg_jit(u, agg_state, akey)
+            jax.block_until_ready(out)
+            agg_s = (time.time() - t0) / 5
+        except Exception:  # noqa: BLE001 - telemetry must not fail the bench
+            pass
+
+        telemetry = {
+            "compile_s": round(counters.get("xla.compile_s", 0.0), 3),
+            "compiles": int(counters.get("xla.compiles", 0)),
+            "cache_hits": int(counters.get("xla.cache_hits", 0)),
+            "cache_misses": int(counters.get("xla.cache_misses", 0)),
+            "agg_s": round(agg_s, 6) if agg_s is not None else None,
+        }
+
         # XLA-cost-model FLOPs of the exact compiled round program (the
         # basis of docs/performance.md's MFU accounting); cost_analysis is
         # best-effort — some backends/attachment modes don't expose it
@@ -310,6 +351,7 @@ def child_main() -> None:
                     "local_steps": local_steps,
                     "train_loss": loss,
                     "tflop_per_round": tflop_per_round,
+                    "telemetry": telemetry,
                     "platform": devices[0].platform,
                     "n_devices": len(devices),
                 }
@@ -506,6 +548,11 @@ def main() -> None:
     if errors:
         payload["attempt_errors"] = "; ".join(errors)[:500]
     payload["platform"] = result.get("platform")
+    # compact telemetry sub-dict (compile/cache accounting + isolated
+    # aggregation cost) measured by the child — absent only when an old
+    # child payload lacks it, never fabricated here
+    if result.get("telemetry") is not None:
+        payload["telemetry"] = result["telemetry"]
     # efficiency fields: sustained TFLOPS from the XLA cost model of the
     # exact compiled round program, and MFU against the v5e bf16 peak.
     # Carried on every path; mfu is null off-accelerator (the CPU fallback

@@ -56,6 +56,31 @@ class Aggregator:
     ) -> Tuple[jnp.ndarray, Any]:
         raise NotImplementedError
 
+    # -- forensics ------------------------------------------------------------
+
+    def diagnostics(self, updates: jnp.ndarray, state: Any = (), **ctx) -> dict:
+        """Per-round forensic pytree: *what the defense decided* (Krum
+        selection indices/scores, trimmed-mean trim-mask summary, clipping
+        norms, FLTrust trust scores — the signals the Byzantine-robustness
+        literature reasons about but no Blades-lineage codebase records).
+
+        Must be jit-compatible: a dict of fixed-shape arrays, traced inside
+        the round program alongside :meth:`aggregate` (XLA CSE dedupes the
+        shared subexpressions, so overriding this costs nothing the defense
+        did not already compute unless the summary itself is extra work).
+        Base implementation: no diagnostics.
+        """
+        return {}
+
+    def aggregate_with_diagnostics(
+        self, updates: jnp.ndarray, state: Any = (), **ctx
+    ) -> Tuple[jnp.ndarray, Any, dict]:
+        """:meth:`aggregate` + :meth:`diagnostics` over the same inputs,
+        as one traceable call (``core/engine.py`` uses this when the engine
+        is built with ``collect_diagnostics=True``)."""
+        agg, new_state = self.aggregate(updates, state, **ctx)
+        return agg, new_state, self.diagnostics(updates, state, **ctx)
+
     # -- host-side convenience ------------------------------------------------
 
     def _coerce(self, inputs) -> jnp.ndarray:

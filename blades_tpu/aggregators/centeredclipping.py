@@ -42,5 +42,13 @@ class Centeredclipping(Aggregator):
         momentum = jax.lax.fori_loop(0, self.n_iter, body, state.astype(updates.dtype))
         return momentum, momentum
 
+    def diagnostics(self, updates, state=(), **ctx):
+        """Forensics: per-client distance from the incoming momentum center
+        and whether the clip engaged (``|u_i - v| > tau``) on the first
+        inner iteration — which clients the defense had to restrain."""
+        v = state.astype(updates.dtype)
+        norms = jnp.sqrt(jnp.maximum(jnp.sum((updates - v) ** 2, axis=1), 1e-24))
+        return {"clip_norms": norms, "clipped": norms > self.tau}
+
     def __repr__(self):
         return f"Clipping (tau={self.tau}, n_iter={self.n_iter})"

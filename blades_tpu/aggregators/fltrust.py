@@ -28,23 +28,38 @@ class Fltrust(Aggregator):
             raise ValueError("fltrust requires exactly one trusted client")
         return super().__call__(inputs, **ctx)
 
+    @staticmethod
+    def _trust_scores(updates, trusted_mask):
+        """Shared by aggregate + diagnostics (one formula, one place):
+        returns ``(ts, t_norm, norms)`` — relu'd cosine trust per client
+        (0 for the trusted client itself), the trusted update's norm, and
+        every client's norm."""
+        trusted_mask = jnp.asarray(trusted_mask).astype(bool)
+        trusted = updates[jnp.argmax(trusted_mask)]
+        t_norm = jnp.sqrt(jnp.sum(trusted**2))
+        norms = jnp.sqrt(jnp.maximum(jnp.sum(updates**2, axis=1), 0.0))
+        cos = (updates @ trusted) / jnp.maximum(norms * t_norm, 1e-6)
+        ts = jnp.maximum(cos, 0.0) * (~trusted_mask)  # relu + exclude trusted
+        return ts, t_norm, norms
+
     def aggregate(self, updates, state=(), *, trusted_mask=None, **ctx):
         if trusted_mask is None:
             raise ValueError(
                 "fltrust requires a trusted_mask (set_trusted_clients)"
             )
-        trusted_mask = jnp.asarray(trusted_mask).astype(bool)
-        t_idx = jnp.argmax(trusted_mask)
-        trusted = updates[t_idx]
-        t_norm = jnp.sqrt(jnp.sum(trusted**2))
-
-        norms = jnp.sqrt(jnp.maximum(jnp.sum(updates**2, axis=1), 0.0))
-        cos = (updates @ trusted) / jnp.maximum(norms * t_norm, 1e-6)
-        ts = jnp.maximum(cos, 0.0) * (~trusted_mask)  # relu + exclude trusted
-
+        ts, t_norm, norms = self._trust_scores(updates, trusted_mask)
         rescaled = updates * (t_norm / jnp.maximum(norms, 1e-24))[:, None]
         # when every untrusted update opposes the trusted one (all trust
         # scores zero) the reference divides 0/0 -> NaN; return the zero
         # vector instead (skip the round) — safer and still "no information
         # accepted from untrusted clients".
         return (ts @ rescaled) / jnp.maximum(jnp.sum(ts), 1e-12), state
+
+    def diagnostics(self, updates, state=(), *, trusted_mask=None, **ctx):
+        """Forensics: the per-client trust scores — exactly the weights
+        :meth:`aggregate` applies this round (same ``_trust_scores`` call,
+        so the two cannot diverge)."""
+        if trusted_mask is None:
+            return {}
+        ts, _, _ = self._trust_scores(updates, trusted_mask)
+        return {"trust_scores": ts}

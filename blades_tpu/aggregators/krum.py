@@ -50,15 +50,28 @@ class Krum(Aggregator):
         nearest = jnp.sort(d2, axis=1)[:, : k - self.f - 2]
         return jnp.sum(nearest, axis=1)
 
-    def aggregate(self, updates, state=(), **ctx):
+    def _select(self, updates):
+        """Shared by aggregate + diagnostics: ``(scores [K], selected [m])``."""
         scores = self.scores(updates)
-        top_m = jnp.argsort(scores)[: self.m]
+        return scores, jnp.argsort(scores)[: self.m]
+
+    def aggregate(self, updates, state=(), **ctx):
+        _, top_m = self._select(updates)
         # the reference sums the selected updates (`krum.py:120`) but only
         # ever runs m=1 (`krum.py:114`), where sum == mean == the single
         # closest vector. The Multi-Krum paper averages the m selected
         # updates, so for the m>1 surface the reference never exposes we
         # follow the paper — a sum would scale the pseudo-gradient by m.
         return jnp.mean(updates[top_m], axis=0), state
+
+    def diagnostics(self, updates, state=(), **ctx):
+        """Forensics: the full per-client score vector and the ``m``
+        selected client indices — which clients the defense trusted this
+        round (the quantity Krum-analysis papers reason about; same
+        ``_select`` call as :meth:`aggregate`, so the recorded selection is
+        by construction the one applied)."""
+        scores, top_m = self._select(updates)
+        return {"scores": scores, "selected": top_m.astype(jnp.int32)}
 
     def __repr__(self):
         return f"Krum (m={self.m})"

@@ -21,14 +21,35 @@ class Trimmedmean(Aggregator):
         # `nb` mirrors the reference ctor arg name (`trimmedmean.py:24`).
         self.b = nb if nb is not None else num_byzantine
 
-    def aggregate(self, updates, state=(), **ctx):
-        k = updates.shape[0]
+    def _effective_b(self, k: int) -> int:
         b = self.b
         while k - 2 * b <= 0:  # trace-time auto-shrink, parity with reference
             b -= 1
         if b < 0:
             raise RuntimeError(f"cannot trim {self.b} from {k} clients")
-        return trimmed_mean(updates, b), state
+        return b
+
+    def aggregate(self, updates, state=(), **ctx):
+        return trimmed_mean(updates, self._effective_b(updates.shape[0])), state
+
+    def diagnostics(self, updates, state=(), **ctx):
+        """Forensics: per-client count of coordinates where that client's
+        value was trimmed (rank < b or rank >= K-b along the client axis),
+        plus the effective b. A client whose rows are trimmed at nearly
+        every coordinate is what the defense *treats* as an outlier — under
+        attack, compare against the ground-truth byzantine mask
+        (``byz_trim_frac`` in the telemetry round records).
+
+        Costs one [K, D] double-argsort the aggregate itself does not need —
+        only traced when diagnostics are requested."""
+        k = updates.shape[0]
+        b = self._effective_b(k)
+        ranks = jnp.argsort(jnp.argsort(updates, axis=0), axis=0)
+        trimmed = (ranks < b) | (ranks >= k - b)
+        return {
+            "trim_counts": trimmed.sum(axis=1).astype(jnp.int32),
+            "trim_b": jnp.asarray(b, jnp.int32),
+        }
 
     def __repr__(self):
         return f"Trimmed Mean (b={self.b})"

@@ -35,6 +35,7 @@ from blades_tpu.aggregators.base import Aggregator
 from blades_tpu.attackers.base import Attack, NoAttack
 from blades_tpu.ops.pytree import make_unraveler, ravel
 from blades_tpu.parallel.mesh import ShardingPlan
+from blades_tpu.telemetry import get_recorder
 from blades_tpu.utils import rng
 
 
@@ -145,6 +146,7 @@ class RoundEngine:
         remat: bool = False,
         keep_updates: bool = True,
         donate_batches: bool = False,
+        collect_diagnostics: bool = False,
     ):
         """``client_chunks``: split the K client axis into this many
         sequential chunks (``lax.map`` outside, vmap inside). Each chunk still
@@ -172,7 +174,14 @@ class RoundEngine:
         K=1000 headline — for intermediates). Off by default because a
         caller that reuses the same batch arrays across ``run_round``
         calls (e.g. a fixed-batch microbenchmark) would hand XLA a
-        donated-and-consumed buffer."""
+        donated-and-consumed buffer.
+
+        ``collect_diagnostics``: additionally trace the aggregator's
+        forensic pytree (``Aggregator.diagnostics`` — Krum selections,
+        trim-mask summaries, trust scores) into the round program and
+        expose it per round as ``self.last_diagnostics``. Static branch,
+        off by default: some diagnostics (trimmed-mean's rank mask) cost
+        work the aggregate itself does not need."""
         self.train_loss_fn = train_loss_fn
         self.eval_logits_fn = eval_logits_fn
         self.num_clients = int(num_clients)
@@ -192,6 +201,8 @@ class RoundEngine:
             )
         self.remat = bool(remat)
         self.keep_updates = bool(keep_updates)
+        self.collect_diagnostics = bool(collect_diagnostics)
+        self.last_diagnostics: Any = None
 
         self.dim, self.unravel = make_unraveler(params_template)
         # Reference convention: the FIRST num_byzantine client ids are
@@ -376,15 +387,25 @@ class RoundEngine:
         # parity: reference nan_to_num's every uploaded update (client.py:195-198)
         updates = jnp.nan_to_num(updates)
         if self.plan is not None:
-            updates = lax.with_sharding_constraint(updates, self.plan.updates)
+            # clients-axis constraint ONLY — never P(clients, model) here.
+            # Resharding the fresh [K, D] matrix along the model axis
+            # miscompiles under some XLA SPMD-partitioner versions whenever
+            # the mesh has a >1 model axis (regardless of divisibility, and
+            # a two-hop constraint chain collapses to the same program):
+            # the replicated flat0 broadcast inside the vmapped
+            # ``ravel(pf) - flat0`` gets dropped and every row comes out as
+            # ``update + ravel(params)`` — silent corruption that collapses
+            # multi-round training (regression:
+            # tests/test_engine.py::test_sharded_2d_mesh_matches_unsharded).
+            # GSPMD still shards the aggregation reductions internally as it
+            # sees fit; only the explicit model-axis reshard is the trigger.
+            updates = lax.with_sharding_constraint(updates, self.plan.clients)
 
         updates, attack_state = self.attack.on_updates(
             updates, self.byz_mask, attack_key, state.attack_state
         )
 
-        agg, agg_state = self.aggregator.aggregate(
-            updates,
-            state.agg_state,
+        agg_ctx = dict(
             trusted_mask=self.trusted_mask,
             # current flat params for defenses that track the model
             # trajectory (byzantinesgd's A-accumulator); dead code — and
@@ -392,6 +413,17 @@ class RoundEngine:
             params_flat=ravel(state.params),
             key=jax.random.fold_in(round_key, rng.AGG),
         )
+        if self.collect_diagnostics:
+            # static branch: forensic pytree (selection indices, trim masks,
+            # trust scores) traced alongside the aggregate
+            agg, agg_state, agg_diag = self.aggregator.aggregate_with_diagnostics(
+                updates, state.agg_state, **agg_ctx
+            )
+        else:
+            agg, agg_state = self.aggregator.aggregate(
+                updates, state.agg_state, **agg_ctx
+            )
+            agg_diag = {}
 
         # server pseudo-gradient step: grad := -agg (server.py:54-75)
         grad_tree = self.unravel(-agg)
@@ -427,7 +459,7 @@ class RoundEngine:
         )
         # static branch: when the caller never reads the matrix, don't make
         # it a program output (outputs persist in HBM across rounds)
-        return new_state, metrics, updates if self.keep_updates else ()
+        return new_state, metrics, updates if self.keep_updates else (), agg_diag
 
     def run_round(
         self,
@@ -443,16 +475,25 @@ class RoundEngine:
         The post-attack ``[K, D]`` update matrix of the round stays available
         as ``self.last_updates`` (device-resident; only materialized on host
         if the caller reads it) when the engine was built with
-        ``keep_updates=True`` (default); ``None`` otherwise."""
-        new_state, metrics, updates = self._round_jit(
-            state,
-            cx,
-            cy,
-            jnp.asarray(client_lr, jnp.float32),
-            jnp.asarray(server_lr, jnp.float32),
-            key,
-        )
+        ``keep_updates=True`` (default); ``None`` otherwise. With
+        ``collect_diagnostics=True`` the aggregator's forensic pytree is
+        likewise available as ``self.last_diagnostics``.
+
+        Telemetry: the async program dispatch runs under a ``dispatch``
+        span on the active recorder (``blades_tpu.telemetry``); the span
+        measures trace+enqueue cost, NOT device execution — callers that
+        want the device wall time block inside their own span."""
+        with get_recorder().span("dispatch"):
+            new_state, metrics, updates, agg_diag = self._round_jit(
+                state,
+                cx,
+                cy,
+                jnp.asarray(client_lr, jnp.float32),
+                jnp.asarray(server_lr, jnp.float32),
+                key,
+            )
         self.last_updates = updates if self.keep_updates else None
+        self.last_diagnostics = agg_diag if self.collect_diagnostics else None
         return new_state, metrics
 
     # -- evaluation ----------------------------------------------------------

@@ -1,7 +1,9 @@
 """WideResNet-28-10 in flax, GroupNorm-normalized (BASELINE.md config 5).
 
 Pre-activation wide residual blocks (Zagoruyko & Komodakis). GroupNorm for
-the same pure-function reason as resnet.py.
+the same pure-function reason as resnet.py. Not in the reference's model
+zoo (its CIFAR stable is ResNet-only, ``src/blades/models/cifar10/``);
+added for the BASELINE.md config ladder.
 """
 
 from __future__ import annotations

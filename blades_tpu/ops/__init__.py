@@ -1,3 +1,7 @@
+"""Device-side primitive ops namespace (re-exports; reference
+counterpart: none — the reference has no op layer, its defenses run as
+host-side torch; per-module citations live in each op file)."""
+
 from blades_tpu.ops.pytree import (  # noqa: F401
     flat_dim,
     make_unraveler,

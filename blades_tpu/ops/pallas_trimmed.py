@@ -23,6 +23,10 @@ helper that rejects Mosaic programs, and a kernel that cannot compile must
 not poison the whole round program's compile). ``interpret=True`` runs the
 kernel in interpreter mode (used by CPU tests to validate the kernel logic
 itself); ``BLADES_TPU_NO_PALLAS=1`` forces the sort path.
+
+Reference counterpart: the two-``topk`` host-side selection in
+``src/blades/aggregators/trimmedmean.py:29-44``; the kernelization itself
+is new surface (the reference has no device kernels).
 """
 
 from __future__ import annotations

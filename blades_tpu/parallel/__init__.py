@@ -1,3 +1,7 @@
+"""Device-mesh parallelism namespace (re-exports; reference counterpart:
+none — the reference parallelizes via Ray actors, see ``mesh.py`` and
+``distributed.py`` here for the per-module citations)."""
+
 from blades_tpu.parallel.mesh import (  # noqa: F401
     CLIENTS_AXIS,
     MODEL_AXIS,

@@ -72,7 +72,14 @@ class ShardingPlan:
     mesh: Mesh
     replicated: NamedSharding      # model params, server opt state, scalars
     clients: NamedSharding         # [K, ...] per-client leading-axis arrays
-    updates: NamedSharding         # [K, D] update matrix: both axes sharded
+    # [K, D] update matrix, both axes sharded. WARNING: do NOT use this as a
+    # with_sharding_constraint target on the matrix produced inside the
+    # round program — resharding it along the model axis miscompiles under
+    # some XLA SPMD-partitioner versions (rows silently become
+    # ``update + params``; see core/engine.py and the regression test
+    # tests/test_engine.py::test_sharded_2d_mesh_matches_unsharded). Safe
+    # for device_put of host-materialized matrices.
+    updates: NamedSharding
     flat_model: NamedSharding      # [D] aggregated vector: sharded along D
 
     def shard_batch(self, tree):

@@ -36,6 +36,7 @@ from blades_tpu.datasets.fl import FLDataset
 from blades_tpu.models.common import ModelSpec, build_fns
 from blades_tpu.parallel.mesh import auto_mesh_shape, make_mesh, make_plan
 from blades_tpu.server import BladesServer
+from blades_tpu.telemetry import Recorder, install_jax_monitoring, set_recorder
 from blades_tpu.utils.checkpoint import checkpoint_file, restore_state, save_state
 from blades_tpu.utils.logging import initialize_logger
 from blades_tpu.utils.metrics import top1_accuracy
@@ -176,6 +177,10 @@ class Simulator:
         self.attack = get_attack(attack, **attack_kws)
 
         initialize_logger(log_path)
+        self.log_path = log_path
+        # replaced by run() with a file-backed recorder (telemetry.jsonl in
+        # the log dir) unless BLADES_TELEMETRY=0
+        self.telemetry = Recorder(enabled=False)
         self.metrics = {"top1": top1_accuracy} if metrics is None else metrics
         self.json_logger = logging.getLogger("stats")
         self.debug_logger = logging.getLogger("debug")
@@ -292,6 +297,7 @@ class Simulator:
         compute_dtype: Optional[str] = None,
         on_round_end: Optional[Callable] = None,
         donate_batches: bool = False,
+        collect_diagnostics: Optional[bool] = None,
     ) -> List[float]:
         """Run adversarial training; returns per-round wall times (reference
         ``run`` contract, ``simulator.py:364-457``).
@@ -311,10 +317,47 @@ class Simulator:
         the round program (safe with the built-in datasets, whose jitted
         sampler returns fresh arrays every round; leave off for a custom
         dataset that caches and re-serves batch arrays).
+        ``collect_diagnostics``: trace the aggregator's forensic pytree
+        (Krum selections, trim masks, trust scores) into the round program
+        and log per-round ``defense`` records to the telemetry trace;
+        default: the ``BLADES_TELEMETRY_DIAG=1`` env knob.
+
+        Telemetry (``docs/observability.md``): unless ``BLADES_TELEMETRY=0``,
+        a span/counter trace of the run is appended to
+        ``<log_path>/telemetry.jsonl`` — per-round span tree (sample /
+        dispatch / sync / eval / checkpoint), XLA compile + persistent-cache
+        accounting, and defense forensics — flushed once per round.
+        Summarize with ``python scripts/trace_summary.py``.
+        ``BLADES_TELEMETRY_PROFILE_DIR`` is an env alias for ``profile_dir``
+        (a ~3-round ``jax.profiler`` capture) for real-TPU windows.
         """
         from blades_tpu.utils.xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
+        if collect_diagnostics is None:
+            collect_diagnostics = os.environ.get("BLADES_TELEMETRY_DIAG") == "1"
+        profile_dir = profile_dir or os.environ.get(
+            "BLADES_TELEMETRY_PROFILE_DIR"
+        ) or None
+        rec = Recorder(
+            path=os.path.join(self.log_path, "telemetry.jsonl"),
+            meta={
+                "run": "simulator",
+                "num_clients": self.dataset.num_clients,
+                "num_byzantine": self.num_byzantine,
+                "attack": repr(self.attack),
+                "aggregator": repr(self.aggregator),
+                "global_rounds": global_rounds,
+                "local_steps": local_steps,
+            },
+        )
+        self.telemetry = rec
+        set_recorder(rec)  # engine spans + jax compile events land here
+        install_jax_monitoring()
+        # create the trace file (meta record) NOW: a run killed mid-compile
+        # — the documented tunnel-hang scenario — must still leave a trace
+        # to post-mortem, not depend on surviving to the first round flush
+        rec.flush()
         spec = self._model_spec(model, loss, compute_dtype)
         batch_size = train_batch_size or self._train_bs
 
@@ -350,6 +393,7 @@ class Simulator:
             # persists in HBM across rounds
             keep_updates=retain_updates or on_round_end is not None,
             donate_batches=donate_batches,
+            collect_diagnostics=collect_diagnostics,
         )
         state = self.engine.init(params)
 
@@ -371,52 +415,84 @@ class Simulator:
         prof_first = min(max(start_round, 2), global_rounds)
         prof_last = min(prof_first + 2, global_rounds)
         trace_active = False
-        for rnd in range(start_round, global_rounds + 1):
-            if profile_dir and rnd == prof_first:
-                jax.profiler.start_trace(profile_dir)
-                trace_active = True
-            round_start = time.time()
-            cx, cy = self.dataset.sample_round(
-                jax.random.fold_in(data_key, rnd), local_steps, batch_size
-            )
-            c_lr = client_lr_fn(rnd - 1)
-            s_lr = server_lr_fn(rnd - 1)
-            state, m = self.engine.run_round(state, cx, cy, c_lr, s_lr, key)
-            self.server.state = state
+        try:
+            for rnd in range(start_round, global_rounds + 1):
+                if profile_dir and rnd == prof_first:
+                    jax.profiler.start_trace(profile_dir)
+                    trace_active = True
+                round_start = time.time()
+                with rec.span("round"):
+                    with rec.span("sample"):
+                        cx, cy = self.dataset.sample_round(
+                            jax.random.fold_in(data_key, rnd), local_steps,
+                            batch_size,
+                        )
+                    c_lr = client_lr_fn(rnd - 1)
+                    s_lr = server_lr_fn(rnd - 1)
+                    # emits the nested round/dispatch span
+                    state, m = self.engine.run_round(state, cx, cy, c_lr, s_lr, key)
+                    self.server.state = state
 
-            self.log_train(rnd, local_steps, m)
-            self.log_variance(rnd, m)
-            if retain_updates:
-                # populate reference-parity client.get_update() views
-                for i, c in enumerate(self.get_clients()):
-                    c.save_update(self.engine.last_updates[i])
-            if on_round_end is not None:
-                # observability hook: (round, state, metrics); the round's
-                # post-attack update matrix is engine.last_updates
-                on_round_end(rnd, state, m)
+                    with rec.span("sync"):
+                        # device execution of the async round program lands
+                        # here (log_train's float() conversions used to
+                        # absorb it)
+                        jax.block_until_ready(m)
+                    self.log_train(rnd, local_steps, m)
+                    self.log_variance(rnd, m)
+                    self._log_defense(rnd)
+                    if retain_updates:
+                        # populate reference-parity client.get_update() views
+                        for i, c in enumerate(self.get_clients()):
+                            c.save_update(self.engine.last_updates[i])
+                    if on_round_end is not None:
+                        # observability hook: (round, state, metrics); the
+                        # round's post-attack update matrix is
+                        # engine.last_updates
+                        on_round_end(rnd, state, m)
 
-            if rnd % validate_interval == 0:
-                ev = self.evaluate(rnd, test_batch_size)
-                self.debug_logger.info(
-                    f"Test global round {rnd}, loss: {ev['Loss']}, top1: {ev['top1']}"
+                    if rnd % validate_interval == 0:
+                        with rec.span("eval"):
+                            ev = self.evaluate(rnd, test_batch_size)
+                        self.debug_logger.info(
+                            f"Test global round {rnd}, loss: {ev['Loss']}, "
+                            f"top1: {ev['top1']}"
+                        )
+
+                    if trace_active and rnd == prof_last:
+                        jax.block_until_ready(state.params)
+                        jax.profiler.stop_trace()
+                        trace_active = False
+                    if (
+                        checkpoint_path
+                        and checkpoint_interval
+                        and rnd % checkpoint_interval == 0
+                    ):
+                        with rec.span("checkpoint"):
+                            save_state(checkpoint_path, state)
+
+                wall = time.time() - round_start
+                round_times.append(wall)
+                # per-round summary + the round's single buffered trace write
+                rec.round_record(
+                    rnd,
+                    wall_s=wall,
+                    train_loss=float(m.train_loss),
+                    train_top1=float(m.train_top1),
                 )
-
-            if trace_active and rnd == prof_last:
-                jax.block_until_ready(state.params)
-                jax.profiler.stop_trace()
-                trace_active = False
-            if (
-                checkpoint_path
-                and checkpoint_interval
-                and rnd % checkpoint_interval == 0
-            ):
-                save_state(checkpoint_path, state)
-
-            round_times.append(time.time() - round_start)
-            self.debug_logger.info(
-                f"E={rnd}; Client learning rate = {c_lr}; "
-                f"Time cost = {time.time() - global_start}"
-            )
+                rec.flush()
+                self.debug_logger.info(
+                    f"E={rnd}; Client learning rate = {c_lr}; "
+                    f"Time cost = {time.time() - global_start}"
+                )
+        finally:
+            # also reached when a round raises (OOM, XLA abort, Ctrl-C on a
+            # hung compile): whatever was recorded up to the failure reaches
+            # the trace. run_end terminates this run's records — anything
+            # after it is ambient post-run activity (the jax.monitoring
+            # listeners stay installed for the life of the process).
+            rec.event("run_end", rounds_completed=len(round_times))
+            rec.flush()
         return round_times
 
     def _model_spec(self, model, loss, compute_dtype=None) -> ModelSpec:
@@ -486,6 +562,50 @@ class Simulator:
             "norm": float(m.update_variance_norm),
         }
         self.json_logger.info(r)
+
+    def _log_defense(self, rnd: int) -> None:
+        """Aggregator forensics -> one ``defense`` telemetry record per
+        round: the raw diagnostics pytree plus byz-overlap summaries — how
+        much of what the defense selected/trimmed/clipped/trusted was
+        actually byzantine (ground truth the simulator knows but a real
+        deployment would not). No reference counterpart: the reference
+        records nothing about defense decisions (``simulator.py:244`` just
+        applies the aggregate)."""
+        diag = self.engine.last_diagnostics
+        if not diag or not self.telemetry.enabled:
+            return
+        byz = np.asarray(self.engine.byz_mask)
+        fields = {}
+        for name, v in diag.items():
+            arr = np.asarray(v)
+            fields[name] = arr.tolist() if arr.ndim else arr.item()
+        overlap = {}
+        if "selected" in diag:  # krum/multikrum: fraction of selections byz
+            sel = np.asarray(diag["selected"])
+            overlap["byz_selected_frac"] = float(byz[sel].mean())
+        if "trim_counts" in diag:  # trimmedmean: byz share of trimmed slots
+            tc = np.asarray(diag["trim_counts"], dtype=np.float64)
+            tot = tc.sum()
+            overlap["byz_trim_frac"] = float(tc[byz].sum() / tot) if tot else 0.0
+        if "clipped" in diag:  # centeredclipping: who hit the clip radius
+            cl = np.asarray(diag["clipped"])
+            overlap["byz_clipped_frac"] = (
+                float(cl[byz].mean()) if byz.any() else 0.0
+            )
+            overlap["honest_clipped_frac"] = (
+                float(cl[~byz].mean()) if (~byz).any() else 0.0
+            )
+        if "trust_scores" in diag:  # fltrust: byz share of total trust mass
+            ts = np.asarray(diag["trust_scores"], dtype=np.float64)
+            tot = ts.sum()
+            overlap["byz_trust_frac"] = (
+                float(ts[byz].sum() / tot) if tot > 0 else 0.0
+            )
+        for name, value in overlap.items():
+            self.telemetry.gauge(f"defense.{name}", value)
+        self.telemetry.event(
+            "defense", round=rnd, agg=repr(self.aggregator), **fields, **overlap
+        )
 
     def evaluate(self, rnd: int, batch_size: int = 64) -> Dict:
         """Reference test flow (``test_actor`` -> ``log_validate``,

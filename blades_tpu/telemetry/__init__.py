@@ -1,0 +1,32 @@
+"""Round-level telemetry: nested spans, counters, compile/cache accounting,
+aggregator forensics, and JSONL trace export.
+
+Reference counterpart: none — the reference logs only whole-round wall time
+and loss/accuracy to its flat ``stats`` file (``src/blades/simulator.py:453-455``,
+``src/blades/utils.py:67-95``). This subsystem is new surface: it records
+*where* each federated round spends time (sample vs. dispatch vs. device
+sync vs. eval), what the XLA compilation cache is doing (critical on hosts
+where a cold compile costs minutes), and what the defense actually decided
+(Krum selections, trimmed-mean trim masks, FLTrust trust scores).
+
+Schema and usage: ``docs/observability.md``. Summaries:
+``python scripts/trace_summary.py <trace.jsonl>``.
+"""
+
+from blades_tpu.telemetry.recorder import (  # noqa: F401
+    NULL_RECORDER,
+    Recorder,
+    get_recorder,
+    install_jax_monitoring,
+    set_recorder,
+    telemetry_enabled,
+)
+
+__all__ = [
+    "Recorder",
+    "NULL_RECORDER",
+    "get_recorder",
+    "set_recorder",
+    "install_jax_monitoring",
+    "telemetry_enabled",
+]

@@ -1,0 +1,333 @@
+"""Zero-dependency telemetry recorder: spans, counters, gauges -> JSONL.
+
+Reference counterpart: none — the reference's only run telemetry is the
+whole-round wall clock appended to its ``stats`` file
+(``src/blades/simulator.py:453-455``); there is no stage breakdown, no
+compile accounting, and no record of defense decisions.
+
+Design constraints (this recorder lives inside the hot round loop):
+
+- **Disabled == free.** ``BLADES_TELEMETRY=0`` (or ``enabled=False``) turns
+  every method into an early-return no-op: no clock reads, no allocations
+  beyond the call itself, and — load-bearing on the single-core box — zero
+  syscalls (``tests/test_telemetry.py`` pins this by making the clock and
+  the sink raise).
+- **Buffered I/O.** Records accumulate in memory; :meth:`flush` writes the
+  pending batch as one buffered write. Callers flush once per round, never
+  per span.
+- **Zero dependencies.** stdlib ``json``/``time``/``os`` only, so the
+  recorder can be imported before jax and used from any subprocess.
+
+JSONL record types (full schema in ``docs/observability.md``):
+
+- ``{"t": "meta", ...}`` — one header record per trace file;
+- ``{"t": "span", "path": "round/dispatch", "dur_s": ...}`` — a closed
+  wall-clock span; ``path`` is the ``/``-joined open-span stack, so nesting
+  needs no explicit parent ids;
+- ``{"t": "round", "round": N, "counters": {...}, "gauges": {...}}`` — a
+  per-round summary carrying counter *deltas* since the previous round
+  record (cumulative totals stay in :attr:`counters`);
+- ``{"t": "compile", ...}`` — one record per XLA backend compile, fed by
+  :func:`install_jax_monitoring`;
+- ``{"t": "defense", ...}`` — aggregator forensics
+  (``simulator.Simulator._log_defense``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+def telemetry_enabled() -> bool:
+    """Environment default: on unless ``BLADES_TELEMETRY=0``."""
+    return os.environ.get("BLADES_TELEMETRY", "1") != "0"
+
+
+class _NullSpan:
+    """Shared reusable no-op context manager (the disabled-span fast path —
+    no generator frame, no clock read)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """An open span; closing emits one ``span`` record to its recorder."""
+
+    __slots__ = ("_rec", "_name", "_attrs", "_start")
+
+    def __init__(self, rec: "Recorder", name: str, attrs: dict):
+        self._rec = rec
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self):
+        self._rec._stack.append(self._name)
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._start
+        stack = self._rec._stack
+        path = "/".join(stack)
+        if stack and stack[-1] == self._name:
+            stack.pop()
+        rec: Dict[str, Any] = {"t": "span", "path": path, "dur_s": dur}
+        if self._attrs:
+            rec.update(self._attrs)
+        self._rec._emit(rec)
+        return False
+
+
+class Recorder:
+    """Nested wall-clock spans, monotonic counters, gauges; JSONL sink.
+
+    ``path=None`` keeps records in memory only (bounded by ``max_buffer``,
+    oldest dropped first) — used by bench.py, which wants counter totals,
+    not a trace file. With a ``path``, :meth:`flush` appends pending records
+    to the file in one buffered write.
+    """
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        enabled: Optional[bool] = None,
+        meta: Optional[dict] = None,
+        max_buffer: int = 65536,
+    ):
+        self.enabled = telemetry_enabled() if enabled is None else bool(enabled)
+        self.path = path if self.enabled else None
+        self.max_buffer = int(max_buffer)
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, Any] = {}
+        self.dropped = 0
+        self._stack: list = []
+        self._pending: list = []  # records not yet flushed to the sink
+        self._fh = None
+        self._last_counts: Dict[str, float] = {}
+        if self.enabled:
+            rec: Dict[str, Any] = {"t": "meta", "ts": time.time(), "pid": os.getpid()}
+            if meta:
+                rec.update(meta)
+            self._emit(rec)
+
+    # -- recording ------------------------------------------------------------
+
+    def span(self, name: str, **attrs):
+        """Context manager timing a nested stage. Path = the open-span stack
+        joined with ``/`` (e.g. ``round/dispatch``)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, attrs)
+
+    def counter(self, name: str, inc: float = 1) -> None:
+        """Add ``inc`` to a cumulative counter (ints or seconds)."""
+        if not self.enabled:
+            return
+        self.counters[name] = self.counters.get(name, 0) + inc
+
+    def gauge(self, name: str, value) -> None:
+        """Set a point-in-time value (last write wins)."""
+        if not self.enabled:
+            return
+        self.gauges[name] = value
+
+    def event(self, type_: str, **fields) -> None:
+        """Emit a free-form record (``t`` = ``type_``)."""
+        if not self.enabled:
+            return
+        self._emit({"t": type_, **fields})
+
+    def round_record(self, round_idx: int, **fields) -> None:
+        """Per-round summary: caller fields + counter deltas since the last
+        round record + current gauges. The natural flush point."""
+        if not self.enabled:
+            return
+        delta = {
+            k: v - self._last_counts.get(k, 0)
+            for k, v in self.counters.items()
+            if v != self._last_counts.get(k, 0)
+        }
+        self._last_counts = dict(self.counters)
+        self._emit(
+            {
+                "t": "round",
+                "round": round_idx,
+                **fields,
+                "counters": delta,
+                "gauges": dict(self.gauges),
+            }
+        )
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Current cumulative counters + gauges (bench.py's telemetry dict)."""
+        return {"counters": dict(self.counters), "gauges": dict(self.gauges)}
+
+    # -- sink -----------------------------------------------------------------
+
+    def _emit(self, record: Dict[str, Any]) -> None:
+        self._pending.append(record)
+        if len(self._pending) > self.max_buffer:
+            # bound the buffer, never the run. Applies to file-backed
+            # recorders too: one that stops being flushed (e.g. a run
+            # ended but the process keeps compiling under the permanent
+            # jax.monitoring listeners) must not grow without limit —
+            # oldest unflushed records drop first, counted in `dropped`.
+            excess = len(self._pending) - self.max_buffer // 2
+            del self._pending[:excess]
+            self.dropped += excess
+
+    def flush(self) -> None:
+        """Write all pending records to the sink in one buffered write.
+        Memory-only recorders keep their records (see :attr:`records`).
+
+        Sink I/O failures (dir deleted, ENOSPC) never propagate — telemetry
+        must not take down the run it observes; the batch is counted into
+        :attr:`dropped` and the handle reset so a later flush retries."""
+        if not self.enabled or self.path is None or not self._pending:
+            return
+        batch = self._pending
+        self._pending = []
+        try:
+            if self._fh is None:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._fh = open(self.path, "a", buffering=1024 * 1024)
+            self._fh.write(
+                "".join(json.dumps(r, default=_json_default) + "\n" for r in batch)
+            )
+            self._fh.flush()
+        except (OSError, TypeError, ValueError):
+            # TypeError/ValueError: a non-serializable record must not
+            # poison the run either (it would re-raise on every retry)
+            self.dropped += len(batch)
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    @property
+    def records(self) -> list:
+        """Unflushed records (the whole trace for memory-only recorders)."""
+        return list(self._pending)
+
+    def close(self) -> None:
+        self.flush()
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def _json_default(obj):
+    """Serialize numpy/jax scalars and small arrays without importing them."""
+    for attr in ("item", "tolist"):
+        if hasattr(obj, attr):
+            try:
+                return getattr(obj, attr)()
+            except Exception:  # noqa: BLE001 - fall through to repr
+                pass
+    return repr(obj)
+
+
+#: Disabled singleton — the default target until someone installs a real one.
+NULL_RECORDER = Recorder(enabled=False)
+
+_global_recorder: Recorder = NULL_RECORDER
+
+
+def get_recorder() -> Recorder:
+    """The process-wide active recorder (NULL_RECORDER until one is set —
+    instrumentation sites call methods unconditionally; disabled methods
+    are no-ops)."""
+    return _global_recorder
+
+
+def set_recorder(rec: Optional[Recorder]) -> Recorder:
+    """Install ``rec`` as the active recorder (``None`` -> NULL_RECORDER);
+    returns the previous one. The previous recorder is flushed and its file
+    handle closed (a sweep creates one recorder per run; handles must not
+    accumulate) — it stays usable: :meth:`Recorder.flush` reopens the sink
+    in append mode on demand."""
+    global _global_recorder
+    prev = _global_recorder
+    if prev is not NULL_RECORDER:
+        prev.close()
+    _global_recorder = rec if rec is not None else NULL_RECORDER
+    return prev
+
+
+# -- XLA compile / persistent-cache accounting --------------------------------
+
+# jax.monitoring event -> counter name (events are unit increments)
+_JAX_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "xla.cache_hits",
+    "/jax/compilation_cache/cache_misses": "xla.cache_misses",
+}
+
+# jax.monitoring duration event -> (count counter | None, seconds counter)
+_JAX_DURATION_COUNTERS = {
+    "/jax/core/compile/backend_compile_duration": ("xla.compiles", "xla.compile_s"),
+    "/jax/core/compile/jaxpr_trace_duration": (None, "xla.trace_s"),
+    "/jax/compilation_cache/compile_time_saved_sec": (None, "xla.compile_saved_s"),
+    "/jax/compilation_cache/cache_retrieval_time_sec": (None, "xla.cache_retrieval_s"),
+}
+
+_jax_monitoring_installed = False
+
+
+def install_jax_monitoring() -> bool:
+    """Forward jax.monitoring compile/cache events to the active recorder.
+
+    Registered once per process (jax keeps listeners forever); the listeners
+    dispatch to :func:`get_recorder` at event time, so recorder swaps are
+    honored and a disabled recorder reduces the listener to a dict lookup.
+    Returns True when the listeners are (already) installed, False when jax
+    lacks the monitoring API.
+    """
+    global _jax_monitoring_installed
+    if _jax_monitoring_installed:
+        return True
+    try:
+        from jax import monitoring
+    except ImportError:
+        return False
+
+    def _on_event(event: str, **kw) -> None:
+        name = _JAX_EVENT_COUNTERS.get(event)
+        if name is not None:
+            get_recorder().counter(name)
+
+    def _on_duration(event: str, duration: float, **kw) -> None:
+        mapped = _JAX_DURATION_COUNTERS.get(event)
+        if mapped is None:
+            return
+        rec = get_recorder()
+        if not rec.enabled:
+            return
+        count_name, secs_name = mapped
+        if count_name is not None:
+            rec.counter(count_name)
+        rec.counter(secs_name, duration)
+        if event == "/jax/core/compile/backend_compile_duration":
+            # one record per backend compile: on this box a cold round
+            # compile costs minutes, so each one is worth a line
+            rec.event("compile", dur_s=duration)
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _jax_monitoring_installed = True
+    return True

@@ -1,3 +1,7 @@
+"""Utility namespace (re-exports; reference counterpart:
+``src/blades/utils.py`` — split here into per-concern modules, each with
+its own citation)."""
+
 from blades_tpu.utils.rng import key_for_round, key_per_client  # noqa: F401
 from blades_tpu.utils.logging import initialize_logger  # noqa: F401
 from blades_tpu.utils.metrics import top1_accuracy, accuracy  # noqa: F401

@@ -6,6 +6,10 @@ registry (``models/pretrained.py``) and the LEAF dataset downloader
 error, stream to a ``.part`` temp file, atomically rename on success, clean
 up and wrap any failure into one RuntimeError naming the manual-placement
 path.
+
+Reference counterpart: the confirm-token fetch in
+``src/blades/models/utils/download_util.py`` (whole-file); the offline
+gate and atomic-rename hardening are new surface.
 """
 
 from __future__ import annotations

@@ -12,30 +12,38 @@ from __future__ import annotations
 import logging
 import os
 import shutil
-from importlib import reload
+
+_RUN_LOGGERS = ("stats", "debug")
 
 
 def initialize_logger(log_root: str) -> None:
-    """(Re)create ``log_root`` and attach fresh ``stats``/``debug`` loggers."""
-    logging.shutdown()
-    reload(logging)
+    """(Re)create ``log_root`` and attach fresh ``stats``/``debug`` loggers.
+
+    Idempotent: re-initialization detaches and closes only this module's
+    two named loggers' handlers before attaching new ones — unlike the
+    reference, whose ``logging.shutdown()`` + module reload
+    (``src/blades/utils.py:67-73``) nukes every logger in the process
+    (including jax's and absl's) and leaks the previous run's file handles.
+    File format is unchanged: one bare ``%(message)s`` per line.
+    """
+    # teardown first (handlers hold the files open), then wipe the dir
+    for name in _RUN_LOGGERS:
+        logger = logging.getLogger(name)
+        for h in list(logger.handlers):
+            logger.removeHandler(h)
+            h.close()
+        logger.setLevel(logging.INFO)
+        # no propagation to the root logger: a root handler (pytest, user
+        # basicConfig) would otherwise echo records in its own format
+        logger.propagate = False
     if os.path.exists(log_root):
         shutil.rmtree(log_root)
     os.makedirs(log_root)
-
-    json_logger = logging.getLogger("stats")
-    json_logger.setLevel(logging.INFO)
-    fh = logging.FileHandler(os.path.join(log_root, "stats"))
-    fh.setLevel(logging.INFO)
-    fh.setFormatter(logging.Formatter("%(message)s"))
-    json_logger.addHandler(fh)
-
-    debug_logger = logging.getLogger("debug")
-    debug_logger.setLevel(logging.INFO)
-    fh = logging.FileHandler(os.path.join(log_root, "debug"))
-    fh.setLevel(logging.INFO)
-    fh.setFormatter(logging.Formatter("%(message)s"))
-    debug_logger.addHandler(fh)
+    for name in _RUN_LOGGERS:
+        fh = logging.FileHandler(os.path.join(log_root, name))
+        fh.setLevel(logging.INFO)
+        fh.setFormatter(logging.Formatter("%(message)s"))
+        logging.getLogger(name).addHandler(fh)
 
 
 def read_stats(log_root: str, type_filter: str | None = None) -> list:

@@ -11,6 +11,9 @@ This module is the single owner of the virtual-CPU flag recipe: the test
 suite (``tests/conftest.py``), the docs example runner (``docs/build.py``),
 the bench CPU fallback, and the driver dryrun all build their environment
 from the helpers here.
+
+Reference counterpart: none — the reference has no accelerator-platform
+plumbing (Ray schedules CPU/GPU actors; ``use_cuda`` is its only knob).
 """
 
 from __future__ import annotations
@@ -25,6 +28,44 @@ import jax
 _COLLECTIVE_TIMEOUT_S = 600
 
 
+def _xla_supports_flag(flag: str) -> bool:
+    """Whether the installed jaxlib registers ``flag`` as an XLA flag.
+
+    XLA F-aborts the whole process on *unknown* entries in ``XLA_FLAGS``
+    (``parse_flags_from_env.cc``), so a flag must never be passed on spec.
+    Registered flags embed their name as a string in the ``xla_extension``
+    binary; a substring scan of that file is the only version-agnostic probe
+    that does not risk the abort. The verdict is cached in the environment,
+    so child processes (docs/build.py examples, bench children, dist
+    workers) inherit it without re-scanning.
+    """
+    cache_key = "_BLADES_XLA_FLAG_" + flag
+    cached = os.environ.get(cache_key)
+    if cached is not None:
+        return cached == "1"
+    supported = False
+    try:
+        import glob
+        import mmap
+
+        import jaxlib
+
+        pattern = os.path.join(os.path.dirname(jaxlib.__file__), "xla_extension*.so*")
+        for so in glob.glob(pattern):
+            with open(so, "rb") as f:
+                m = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+                try:
+                    supported = m.find(flag.encode()) != -1
+                finally:
+                    m.close()
+            if supported:
+                break
+    except Exception:  # noqa: BLE001 - unknown layout: assume unsupported
+        supported = False
+    os.environ[cache_key] = "1" if supported else "0"
+    return supported
+
+
 def virtual_cpu_flags(n_devices: int, existing: str = "") -> str:
     """``XLA_FLAGS`` value for an ``n_devices`` virtual CPU platform.
 
@@ -35,7 +76,11 @@ def virtual_cpu_flags(n_devices: int, existing: str = "") -> str:
         flags = (
             flags + f" --xla_force_host_platform_device_count={n_devices}"
         ).strip()
-    if n_devices > 1 and "collective_call_terminate_timeout" not in flags:
+    if (
+        n_devices > 1
+        and "collective_call_terminate_timeout" not in flags
+        and _xla_supports_flag("xla_cpu_collective_call_terminate_timeout_seconds")
+    ):
         flags += (
             " --xla_cpu_collective_call_terminate_timeout_seconds"
             f"={_COLLECTIVE_TIMEOUT_S}"

@@ -39,6 +39,14 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     an unwritable cache location disables it silently rather than failing
     the run.
     """
+    # compile/cache accounting rides along whether or not the on-disk cache
+    # itself is enabled: every backend compile and every persistent-cache
+    # hit/miss lands on the active telemetry recorder (xla.* counters +
+    # one "compile" record each — on this box a cold round compile costs
+    # minutes, so each is worth a line)
+    from blades_tpu.telemetry import install_jax_monitoring
+
+    install_jax_monitoring()
     if os.environ.get("BLADES_TPU_NO_CACHE") == "1":
         return None
     cache_dir = cache_dir or os.environ.get(

@@ -1,1 +1,4 @@
+"""Package version (reference counterpart: none — the reference keeps
+its version in setuptools metadata only)."""
+
 __version__ = "0.1.0"

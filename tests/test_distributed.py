@@ -123,7 +123,16 @@ def test_cross_process_cluster_runs_sharded_round(nproc, devs):
     also match a single-process run of the same workload."""
     from blades_tpu.parallel._dist_worker import run_local_cluster
 
-    results = run_local_cluster(nproc, devs, timeout=600)
+    try:
+        results = run_local_cluster(nproc, devs, timeout=600)
+    except RuntimeError as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            # some jaxlib builds ship a CPU backend without cross-process
+            # collectives; the topology logic is still covered by the
+            # in-process mesh tests above
+            pytest.skip("this jaxlib's CPU backend lacks multiprocess "
+                        "collectives")
+        raise
     assert set(results) == set(range(nproc)), f"missing results: {results}"
 
     for pid, r in results.items():

@@ -222,6 +222,36 @@ def test_sharded_matches_unsharded(params, ds, agg, attack):
     )
 
 
+def test_sharded_2d_mesh_matches_unsharded(params, ds):
+    """Regression: on a mesh with a >1 ``model`` axis (auto_mesh_shape picks
+    one whenever gcd(devices, K) < devices), constraining the fresh [K, D]
+    update matrix straight to P(clients, model) miscompiled under some XLA
+    SPMD-partitioner versions — every row silently came out as
+    ``update + ravel(params)`` and multi-round training collapsed the
+    params to ~0. The engine therefore constrains the matrix along the
+    clients axis ONLY (a two-hop P(clients)->P(clients, model) chain
+    collapses to the same miscompiled program — do not "restore" the
+    model-axis reshard); this pins single-round equality AND the two
+    summary norms that exposed the bug."""
+    cx, cy = ds.sample_round(jax.random.PRNGKey(1), 1, 8)
+    plan = make_plan(make_mesh(jax.devices(), (2, 4)))  # model axis width 4
+    un = _engine(params, keep_updates=True)
+    sh = _engine(params, plan=plan, keep_updates=True)
+    s_un, m_un = un.run_round(un.init(params), cx, cy, 0.1, 1.0, jax.random.PRNGKey(2))
+    s_sh, m_sh = sh.run_round(sh.init(params), cx, cy, 0.1, 1.0, jax.random.PRNGKey(2))
+    np.testing.assert_allclose(
+        np.asarray(un.last_updates), np.asarray(sh.last_updates),
+        rtol=1e-4, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        float(m_un.agg_norm), float(m_sh.agg_norm), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(ravel(s_un.params)), np.asarray(ravel(s_sh.params)),
+        rtol=1e-5, atol=1e-7,
+    )
+
+
 def test_client_chunks_match_single_vmap(params, ds):
     cx, cy = ds.sample_round(jax.random.PRNGKey(1), 2, 8)
     whole = _engine(params)

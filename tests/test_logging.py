@@ -1,0 +1,58 @@
+"""Run-logging tests: idempotent ``initialize_logger`` (the reference's
+``logging`` module-reload hack replaced by explicit handler teardown,
+``src/blades/utils.py:67-95``) and stats-file parse parity with the
+reference's consumer loop (``examples/Simulation on MNIST.py:69-83``,
+ported as ``read_stats``)."""
+
+import logging
+import os
+
+from blades_tpu.utils.logging import initialize_logger, read_stats
+
+
+def test_reinit_replaces_handlers_not_stacks(tmp_path):
+    root1 = str(tmp_path / "a")
+    root2 = str(tmp_path / "b")
+    initialize_logger(root1)
+    stats = logging.getLogger("stats")
+    assert len(stats.handlers) == 1
+    stats.info({"_meta": {"type": "test"}, "Round": 1, "top1": 0.5})
+    initialize_logger(root2)
+    assert len(stats.handlers) == 1  # replaced, never stacked
+    stats.info({"_meta": {"type": "test"}, "Round": 2, "top1": 0.7})
+    # each run's file holds only its own records (no cross-run duplication)
+    assert [r["Round"] for r in read_stats(root1, "test")] == [1]
+    assert [r["Round"] for r in read_stats(root2, "test")] == [2]
+
+
+def test_reinit_same_dir_wipes_and_keeps_writing(tmp_path):
+    """Handlers are closed BEFORE the dir wipe, so re-initializing the same
+    path can't leave records going to an unlinked file descriptor."""
+    root = str(tmp_path / "out")
+    initialize_logger(root)
+    logging.getLogger("stats").info({"_meta": {"type": "t"}, "x": 1})
+    initialize_logger(root)
+    logging.getLogger("stats").info({"_meta": {"type": "t"}, "x": 2})
+    assert [r["x"] for r in read_stats(root)] == [2]
+
+
+def test_stats_format_byte_compatible(tmp_path):
+    """The on-disk format is the reference's: one bare dict repr per line
+    (what ``read_stats``/the MNIST example's ``read_json`` parse)."""
+    root = str(tmp_path / "out")
+    initialize_logger(root)
+    rec = {"_meta": {"type": "test"}, "Round": 3, "top1": 0.25, "Loss": 1.5}
+    logging.getLogger("stats").info(rec)
+    raw = open(os.path.join(root, "stats")).read()
+    assert raw == repr(rec) + "\n"
+    logging.getLogger("debug").info("free text line")
+    assert open(os.path.join(root, "debug")).read() == "free text line\n"
+
+
+def test_no_propagation_to_root(tmp_path, capsys):
+    """A root handler (pytest's, a user basicConfig) must not duplicate or
+    reformat stats records."""
+    root = str(tmp_path / "out")
+    initialize_logger(root)
+    assert logging.getLogger("stats").propagate is False
+    assert logging.getLogger("debug").propagate is False

@@ -40,7 +40,9 @@ def test_kernel_handles_ties_like_sort():
 def test_b_zero_is_mean():
     u = np.random.RandomState(1).randn(7, 33).astype(np.float32)
     out = trimmed_mean(jnp.asarray(u), 0)
-    np.testing.assert_allclose(np.asarray(out), u.mean(axis=0), rtol=1e-6)
+    # rtol 1e-5, not 1e-6: the XLA lowering is free to reassociate the
+    # K-sum, and fp32 summation order drifts ~2e-6 between XLA builds
+    np.testing.assert_allclose(np.asarray(out), u.mean(axis=0), rtol=1e-5)
 
 
 def test_block_width_respects_vmem():
