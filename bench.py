@@ -160,17 +160,20 @@ def child_main() -> None:
         enable_compilation_cache()  # also installs the jax.monitoring hooks
 
         # pre-flight: a trivial jit proves the backend is up before we pay
-        # for the big compile; retry because backend setup errors are
-        # transient (r01 failed here, r02 failed one compile later)
+        # for the big compile; bounded-backoff retry because backend setup
+        # errors are transient (r01 failed here, r02 failed one compile
+        # later) — each retry is counted into the telemetry sub-dict so a
+        # self-healed tunnel flake still shows in the payload
         stage = "preflight"
-        for attempt in range(3):
-            try:
-                jax.jit(lambda x: x + 1)(jnp.zeros(8)).block_until_ready()
-                break
-            except Exception:
-                if attempt == 2:
-                    raise
-                time.sleep(5)
+        from blades_tpu.utils.retry import retry_call
+
+        retry_call(
+            lambda: jax.jit(lambda x: x + 1)(jnp.zeros(8)).block_until_ready(),
+            attempts=3,
+            base_delay=5.0,
+            max_delay=30.0,
+            describe="backend_preflight",
+        )
 
         stage = "build"
         from blades_tpu.aggregators import get_aggregator
@@ -309,6 +312,8 @@ def child_main() -> None:
             "cache_hits": int(counters.get("xla.cache_hits", 0)),
             "cache_misses": int(counters.get("xla.cache_misses", 0)),
             "agg_s": round(agg_s, 6) if agg_s is not None else None,
+            # backend-acquisition flakes that self-healed via retry_call
+            "retries": int(counters.get("retry.backend_preflight", 0)),
         }
 
         # XLA-cost-model FLOPs of the exact compiled round program (the
@@ -399,7 +404,7 @@ def _run_child(env_overrides: dict, timeout_s: float):
     return result, None
 
 
-def main() -> None:
+def _ladder_main() -> None:
     full_k = int(os.environ.get("BENCH_CLIENTS", 1000))
     full_timeout = float(os.environ.get("BENCH_TIMEOUT", 1500))
     smoke_k = int(os.environ.get("BENCH_SMOKE_CLIENTS", 100))
@@ -496,6 +501,7 @@ def main() -> None:
             "value": None,
             "unit": "rounds/sec",
             "vs_baseline": None,
+            "stage": "ladder",
             "error": "; ".join(errors)[:1000],
         }
         prior = prior_tpu_capture()
@@ -571,6 +577,31 @@ def main() -> None:
         if prior is not None:
             payload["prior_tpu_capture"] = prior
     print(json.dumps(payload))
+
+
+def main() -> None:
+    """One-JSON-line contract, unconditionally: even a bug in the parent
+    ladder itself (bad BASELINE_PROXY.json, OSError on results/, a typo in
+    a future edit) must reach the driver as a single parseable error line,
+    never a traceback-only death with empty-stdout."""
+    try:
+        _ladder_main()
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 - the contract IS the catch-all
+        print(
+            json.dumps(
+                {
+                    "metric": METRIC,
+                    "value": None,
+                    "unit": "rounds/sec",
+                    "vs_baseline": None,
+                    "stage": "parent",
+                    "error": f"{type(e).__name__}: {e}"[:1000],
+                }
+            )
+        )
+        sys.exit(1)
 
 
 if __name__ == "__main__":

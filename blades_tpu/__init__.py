@@ -61,6 +61,7 @@ _LAZY = {
     "RoundEngine": ("blades_tpu.core", "RoundEngine"),
     "ClientOptSpec": ("blades_tpu.core", "ClientOptSpec"),
     "ServerOptSpec": ("blades_tpu.core", "ServerOptSpec"),
+    "FaultModel": ("blades_tpu.faults", "FaultModel"),
 }
 
 

@@ -38,8 +38,25 @@ class Autogm(Aggregator):
         self.inner_maxiter = inner_maxiter
 
     def aggregate(self, updates, state=(), **ctx):
+        return self._aggregate_impl(updates, state, mask=None)
+
+    def _masked_aggregate(self, updates, state, *, mask, **ctx):
+        return self._aggregate_impl(updates, state, mask=mask)
+
+    def _aggregate_impl(self, updates, state, mask):
+        """Shared solve; ``mask`` restricts the weight search and the inner
+        Weiszfeld solves to the participating rows (``None`` = all, the
+        pre-mask program). ``lamb`` stays ``K``-scaled even under dropout —
+        the penalty is a static hyperparameter, not a population statistic.
+        """
         k = updates.shape[0]
         lamb = float(k) if self.lamb is None else self.lamb
+        msk = None if mask is None else mask.astype(updates.dtype)
+        n = (
+            jnp.asarray(k, jnp.int32)
+            if mask is None
+            else jnp.sum(mask.astype(jnp.int32))
+        )
 
         def dists(z):
             return jnp.sqrt(jnp.maximum(jnp.sum((updates - z) ** 2, axis=1), 0.0))
@@ -51,12 +68,16 @@ class Autogm(Aggregator):
                 maxiter=self.inner_maxiter,
                 eps=self.eps,
                 ftol=self.ftol,
+                mask=mask,
             )
 
         def global_obj(z, alpha):
             return jnp.sum(alpha * dists(z)) + lamb * jnp.sum(alpha**2) / 2.0
 
-        alpha0 = jnp.full((k,), 1.0 / k, dtype=updates.dtype)
+        if msk is None:
+            alpha0 = jnp.full((k,), 1.0 / k, dtype=updates.dtype)
+        else:
+            alpha0 = msk / jnp.maximum(jnp.sum(msk), 1.0)
         z0 = solve_gm(alpha0)
         obj0 = global_obj(z0, alpha0)
 
@@ -69,16 +90,25 @@ class Autogm(Aggregator):
         def body(carry):
             i, z, alpha, obj, _ = carry
             d = dists(z)
-            d_sorted = jnp.sort(d)
+            # masked rows sort past every participant; their -inf slack in
+            # the eta test invalidates their prefix positions automatically
+            d_sorted = jnp.sort(d if msk is None else jnp.where(mask, d, jnp.inf))
             # eta_p = (sum of p+1 smallest distances + lamb) / (p + 1); the
             # optimal eta is the last one in the maximal valid prefix
             # (eta_p >= d_(p)), cf. `autogm.py:53-59`.
             p1 = jnp.arange(1, k + 1, dtype=d.dtype)
-            etas = (jnp.cumsum(d_sorted) + lamb) / p1
+            summable = (
+                d_sorted
+                if msk is None
+                else jnp.where(jnp.arange(k) < n, d_sorted, 0.0)
+            )
+            etas = (jnp.cumsum(summable) + lamb) / p1
             valid = jnp.cumprod((etas - d_sorted >= 0).astype(jnp.int32))
             count = jnp.sum(valid)
             eta_opt = jnp.where(count > 0, etas[jnp.maximum(count - 1, 0)], 1e16)
             alpha_new = jnp.maximum(eta_opt - d, 0.0) / lamb
+            if msk is not None:
+                alpha_new = alpha_new * msk
             z_new = solve_gm(alpha_new)
             obj_new = global_obj(z_new, alpha_new)
             return i + 1, z_new, alpha_new, obj_new, obj
@@ -86,4 +116,6 @@ class Autogm(Aggregator):
         _, z, _, _, _ = jax.lax.while_loop(
             cond, body, (jnp.array(0), z0, alpha0, obj0, jnp.inf)
         )
+        if msk is not None:
+            z = jnp.where(n > 0, z, jnp.zeros_like(z))
         return z, state

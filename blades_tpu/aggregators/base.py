@@ -56,6 +56,79 @@ class Aggregator:
     ) -> Tuple[jnp.ndarray, Any]:
         raise NotImplementedError
 
+    # -- graceful degradation (partial participation) -------------------------
+
+    def aggregate_masked(
+        self,
+        updates: jnp.ndarray,
+        state: Any = (),
+        *,
+        mask: Optional[jnp.ndarray] = None,
+        **ctx,
+    ) -> Tuple[jnp.ndarray, Any]:
+        """:meth:`aggregate` over the participating subset of clients.
+
+        ``mask`` is a boolean ``[K]`` participation mask (``blades_tpu.faults``):
+        masked-out rows must not influence the result in ANY way — their
+        payload may be stale garbage or NaN/Inf. The wrapper zeroes them
+        before dispatching to :meth:`_masked_aggregate`, so implementations
+        only reason about *weighting* (sentinel sorts, rank masks, masked
+        reductions), never about non-finite payloads.
+
+        Contracts pinned by ``tests/test_faults.py`` for every registered
+        aggregator: (1) an all-ones mask is bit-identical to the unmasked
+        :meth:`aggregate`; (2) the content of a masked-out row cannot change
+        the result. ``mask=None`` statically routes to the unmasked path
+        (the engine without a fault model compiles the exact same program
+        as before this API existed).
+        """
+        if mask is None:
+            return self.aggregate(updates, state, **ctx)
+        mask, safe = self._sanitize(updates, mask)
+        return self._masked_aggregate(safe, state, mask=mask, **ctx)
+
+    @staticmethod
+    def _sanitize(updates, mask):
+        """Boolean-ize the mask and zero masked-out rows (single owner of
+        the rule that excluded payloads never reach defense arithmetic)."""
+        mask = jnp.asarray(mask).astype(bool)
+        return mask, jnp.where(mask[:, None], updates, 0.0)
+
+    def _masked_aggregate(
+        self, updates: jnp.ndarray, state: Any, *, mask: jnp.ndarray, **ctx
+    ) -> Tuple[jnp.ndarray, Any]:
+        """Mask-aware core; ``updates`` arrives with masked-out rows zeroed.
+
+        Every registered aggregator overrides this (enforced by the tier-1
+        mask-API test) — the base raises so a new defense cannot silently
+        ship without graceful degradation under partial participation.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement mask-aware "
+            "aggregation (_masked_aggregate); see docs/robustness.md"
+        )
+
+    def aggregate_masked_with_diagnostics(
+        self,
+        updates: jnp.ndarray,
+        state: Any = (),
+        *,
+        mask: Optional[jnp.ndarray] = None,
+        **ctx,
+    ) -> Tuple[jnp.ndarray, Any, dict]:
+        """:meth:`aggregate_masked` + :meth:`diagnostics`, one traceable call
+        (the engine's ``collect_diagnostics`` path under a fault model).
+
+        Diagnostics run on the SANITIZED matrix (masked-out rows zeroed) —
+        a corrupted NaN row the guard excluded must not NaN the forensic
+        scores the telemetry records either."""
+        if mask is None:
+            agg, new_state = self.aggregate(updates, state, **ctx)
+            return agg, new_state, self.diagnostics(updates, state, **ctx)
+        mask, safe = self._sanitize(updates, mask)
+        agg, new_state = self._masked_aggregate(safe, state, mask=mask, **ctx)
+        return agg, new_state, self.diagnostics(safe, state, mask=mask, **ctx)
+
     # -- forensics ------------------------------------------------------------
 
     def diagnostics(self, updates: jnp.ndarray, state: Any = (), **ctx) -> dict:

@@ -49,6 +49,17 @@ class Byzantinesgd(Aggregator):
         }
 
     def aggregate(self, updates, state, *, params_flat=None, **ctx):
+        return self._aggregate_impl(updates, state, params_flat, None)
+
+    def _masked_aggregate(self, updates, state, *, mask, params_flat=None, **ctx):
+        return self._aggregate_impl(updates, state, params_flat, mask)
+
+    def _aggregate_impl(self, updates, state, params_flat, mask):
+        """``mask=None`` is the full-population program. Under partial
+        participation an absent client's A/B accumulators FREEZE (no upload
+        to accumulate), the filters still run on the frozen values (the
+        reference filter is history-based, so this is its natural
+        extension), and the final average weights good ∩ participating."""
         if params_flat is None:
             raise ValueError("byzantinesgd needs params_flat context")
         init_params = jnp.where(
@@ -56,8 +67,13 @@ class Byzantinesgd(Aggregator):
         )
         model_diff = params_flat - init_params
 
-        A = state["A"] + updates @ model_diff
-        B = state["B"] + updates
+        inc_a = updates @ model_diff
+        inc_b = updates
+        if mask is not None:
+            inc_a = jnp.where(mask, inc_a, 0.0)
+            inc_b = jnp.where(mask[:, None], inc_b, 0.0)
+        A = state["A"] + inc_a
+        B = state["B"] + inc_b
 
         A_med = jnp.median(A)
         B_med = B[_vector_median_idx(B, self.th_B)]
@@ -69,6 +85,8 @@ class Byzantinesgd(Aggregator):
         good = state["good"] & a_ok & b_ok & g_ok
 
         w = good.astype(updates.dtype)
+        if mask is not None:
+            w = w * mask.astype(updates.dtype)
         agg = (w @ updates) / jnp.maximum(jnp.sum(w), 1.0)
         new_state = {
             "A": A,

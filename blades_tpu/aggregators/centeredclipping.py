@@ -42,6 +42,26 @@ class Centeredclipping(Aggregator):
         momentum = jax.lax.fori_loop(0, self.n_iter, body, state.astype(updates.dtype))
         return momentum, momentum
 
+    def _masked_aggregate(self, updates, state, *, mask, **ctx):
+        # masked mean of the clipped differences: absent clients neither
+        # pull the momentum nor damp it (unlike the async variant, which
+        # deliberately keeps K in the denominator)
+        tau = self.tau
+        m = mask.astype(updates.dtype)
+        denom = jnp.maximum(jnp.sum(m), 1.0)
+
+        def clip_rows(v):
+            norms = jnp.sqrt(jnp.maximum(jnp.sum(v * v, axis=1), 1e-24))
+            scale = jnp.minimum(1.0, tau / norms)
+            return v * scale[:, None]
+
+        def body(_, momentum):
+            clipped = clip_rows(updates - momentum)
+            return momentum + jnp.sum(clipped * m[:, None], axis=0) / denom
+
+        momentum = jax.lax.fori_loop(0, self.n_iter, body, state.astype(updates.dtype))
+        return momentum, momentum
+
     def diagnostics(self, updates, state=(), **ctx):
         """Forensics: per-client distance from the incoming momentum center
         and whether the clip engaged (``|u_i - v| > tau``) on the first

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from blades_tpu.aggregators.base import Aggregator
 from blades_tpu.aggregators.clustering import Clustering
+from blades_tpu.ops.masked import masked_median_1d
 
 
 class Clippedclustering(Aggregator):
@@ -69,4 +70,43 @@ class Clippedclustering(Aggregator):
         clipped = jnp.where((norms > threshold)[:, None], updates * coef[:, None], updates)
 
         agg, _ = self._clustering.aggregate(clipped)
+        return agg, new_state
+
+    def _masked_aggregate(self, updates, state, *, mask, **ctx):
+        k = updates.shape[0]
+        norms = jnp.sqrt(jnp.maximum(jnp.sum(updates**2, axis=1), 0.0))
+
+        # ring-buffer discipline under dropout: the write pattern stays
+        # static (k slots per round) so the compiled program is fixed;
+        # absent clients' slots record this round's PARTICIPANT median —
+        # exactly neutral for the buffer's only consumer (the median
+        # threshold) instead of polluting history with zeros. A round with
+        # NO participants has no median to record: the whole buffer update
+        # (values, write pointer, live count) is suppressed via where, so
+        # empty rounds cannot drag the clipping threshold toward zero.
+        n = jnp.sum(mask.astype(jnp.int32))
+        any_part = n > 0
+        med_round = masked_median_1d(norms, mask)
+        writes = jnp.where(mask, norms, med_round).astype(jnp.float32)
+        cap = self.history_cap
+        idx = (state["pos"] + jnp.arange(k)) % cap
+        hist = jnp.where(
+            any_part, state["norms"].at[idx].set(writes), state["norms"]
+        )
+        pos = jnp.where(any_part, (state["pos"] + k) % cap, state["pos"])
+        count = jnp.where(
+            any_part, jnp.minimum(state["count"] + k, cap), state["count"]
+        )
+        new_state = {"norms": hist, "pos": pos, "count": count}
+
+        if self.tau is not None:
+            threshold = jnp.asarray(self.tau, dtype=updates.dtype)
+        else:
+            threshold = self._masked_median(hist, count).astype(updates.dtype)
+
+        coef = jnp.minimum(1.0, threshold / (norms + 1e-6))
+        clipped = jnp.where(
+            (norms > threshold)[:, None], updates * coef[:, None], updates
+        )
+        agg, _ = self._clustering._masked_aggregate(clipped, (), mask=mask)
         return agg, new_state

@@ -47,3 +47,22 @@ class Clustering(Aggregator):
     def aggregate(self, updates, state=(), **ctx):
         labels = complete_linkage_two_clusters(self._matrix(updates))
         return majority_cluster_mean(updates, labels), state
+
+    def _masked_aggregate(self, updates, state, *, mask, **ctx):
+        # Masked-out rows get the metric's MINIMUM value against everyone:
+        # they merge into some real cluster at zero linkage cost, which is
+        # exactly neutral for complete linkage (cluster-to-cluster heights
+        # are maxima, and the minimum can never be one), then majority and
+        # mean count participants only. Static shapes throughout — no
+        # data-dependent compaction.
+        k = updates.shape[0]
+        m = self._matrix(updates)
+        first = -1.0 if self.metric == "similarity" else 0.0
+        out_pair = (~mask[:, None] | ~mask[None, :]) & ~jnp.eye(k, dtype=bool)
+        labels = complete_linkage_two_clusters(jnp.where(out_pair, first, m))
+        mf = mask.astype(updates.dtype)
+        size1 = jnp.sum(mf * labels)
+        size0 = jnp.sum(mf) - size1
+        majority = jnp.where(size1 > size0, 1, 0)
+        sel = (labels == majority).astype(updates.dtype) * mf
+        return (sel @ updates) / jnp.maximum(jnp.sum(sel), 1.0), state

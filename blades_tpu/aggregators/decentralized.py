@@ -178,6 +178,12 @@ class Asyncmean(Aggregator):
         u = jnp.where(present[:, None], updates, 0.0)
         return u.sum(axis=0) / k, state
 
+    def _masked_aggregate(self, updates, state, *, mask, **ctx):
+        # the participation mask IS the async `present` mask; the 1/K
+        # damping of absent workers is this family's defining semantics,
+        # so it is kept (aggregate_masked already zeroed absent rows)
+        return updates.sum(axis=0) / updates.shape[0], state
+
     def __repr__(self):
         return "Asyncmean"
 
@@ -208,6 +214,11 @@ class Asynccenteredclipping(Aggregator):
             clipped = jnp.where(present[:, None], clipped, 0.0)
             momentum = momentum + clipped.sum(axis=0) / k
         return momentum, momentum
+
+    def _masked_aggregate(self, updates, state, *, mask, **ctx):
+        # participation mask -> async `present` mask (1/K damping kept:
+        # that deliberate under-step on absences is the async semantics)
+        return self.aggregate(updates, state, present=mask)
 
     def __repr__(self):
         return f"Asynccenteredclipping(tau={self.tau}, n_iter={self.n_iter})"

@@ -46,6 +46,17 @@ class Dnc(Aggregator):
         self.power_iters = power_iters
 
     def aggregate(self, updates, state=(), *, key=None, **ctx):
+        return self._aggregate_impl(updates, state, key, None)
+
+    def _masked_aggregate(self, updates, state, *, mask, key=None, **ctx):
+        return self._aggregate_impl(updates, state, key, mask)
+
+    def _aggregate_impl(self, updates, state, key, mask):
+        """``mask=None`` is the full-population program. Under partial
+        participation the principal direction and the outlier scores are
+        computed over participants only (absent rows contribute zero to the
+        centered submatrix), and the ``c*f`` removal budget still targets
+        the largest PARTICIPANT scores (absent rows score ``-inf``)."""
         if key is None:
             key = jax.random.key(0)
         k, d = updates.shape
@@ -58,16 +69,26 @@ class Dnc(Aggregator):
             k_idx, k_init = jax.random.split(subkey)
             idx = jax.random.choice(k_idx, d, shape=(sub_dim,), replace=False)
             sub = updates[:, idx]
-            centered = sub - jnp.mean(sub, axis=0)
+            if mask is None:
+                centered = sub - jnp.mean(sub, axis=0)
+            else:
+                m = mask.astype(sub.dtype)
+                mean = jnp.sum(sub * m[:, None], axis=0) / jnp.maximum(
+                    jnp.sum(m), 1.0
+                )
+                centered = jnp.where(mask[:, None], sub - mean, 0.0)
             v = _top_singular_dir(centered, self.power_iters, k_init)
             scores = (centered @ v) ** 2
+            if mask is not None:
+                scores = jnp.where(mask, scores, -jnp.inf)
             # keep everyone except the n_remove largest scores
             cutoff = jnp.sort(scores)[k - n_remove - 1]
             good = good & (scores <= cutoff)
             return good, None
 
         keys = jax.random.split(key, self.num_iters)
-        good, _ = jax.lax.scan(one_iter, jnp.ones((k,), dtype=bool), keys)
+        good0 = jnp.ones((k,), dtype=bool) if mask is None else mask
+        good, _ = jax.lax.scan(one_iter, good0, keys)
         w = good.astype(updates.dtype)
         return (w @ updates) / jnp.maximum(jnp.sum(w), 1.0), state
 

@@ -55,6 +55,20 @@ class Fltrust(Aggregator):
         # accepted from untrusted clients".
         return (ts @ rescaled) / jnp.maximum(jnp.sum(ts), 1e-12), state
 
+    def _masked_aggregate(self, updates, state, *, mask, trusted_mask=None, **ctx):
+        if trusted_mask is None:
+            raise ValueError(
+                "fltrust requires a trusted_mask (set_trusted_clients)"
+            )
+        # absent clients earn zero trust; when the TRUSTED client itself
+        # drops, its zeroed row has zero norm, every cosine collapses to 0,
+        # and the round degrades to the zero update (skip) — the documented
+        # all-trust-zero fallback above, reached through the same arithmetic
+        ts, t_norm, norms = self._trust_scores(updates, trusted_mask)
+        ts = ts * mask.astype(updates.dtype)
+        rescaled = updates * (t_norm / jnp.maximum(norms, 1e-24))[:, None]
+        return (ts @ rescaled) / jnp.maximum(jnp.sum(ts), 1e-12), state
+
     def diagnostics(self, updates, state=(), *, trusted_mask=None, **ctx):
         """Forensics: the per-client trust scores — exactly the weights
         :meth:`aggregate` applies this round (same ``_trust_scores`` call,

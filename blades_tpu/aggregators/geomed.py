@@ -25,18 +25,36 @@ def weiszfeld(
     maxiter: int = 100,
     eps: float = 1e-6,
     ftol: float = 1e-10,
+    mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Solve ``argmin_z sum_i a_i |z - x_i|`` over rows of ``updates``."""
+    """Solve ``argmin_z sum_i a_i |z - x_i|`` over rows of ``updates``.
+
+    ``mask`` restricts the solve to the participating rows (``None`` is the
+    full population, bit-identical to the pre-mask behavior): masked-out
+    rows start at zero weight and the ``eps`` weight floor — which would
+    otherwise resurrect them — is re-masked every iteration.
+    """
     k = updates.shape[0]
+    msk = None if mask is None else mask.astype(updates.dtype)
     if init_weights is None:
-        alphas0 = jnp.full((k,), 1.0 / k, dtype=updates.dtype)
+        if msk is None:
+            alphas0 = jnp.full((k,), 1.0 / k, dtype=updates.dtype)
+        else:
+            alphas0 = msk / jnp.maximum(jnp.sum(msk), 1.0)
     else:
         alphas0 = init_weights.astype(updates.dtype)
+        if msk is not None:
+            alphas0 = alphas0 * msk
 
     def dists(z):
         return jnp.sqrt(jnp.maximum(jnp.sum((updates - z) ** 2, axis=1), 0.0))
 
-    z0 = jnp.mean(updates, axis=0)
+    if msk is None:
+        z0 = jnp.mean(updates, axis=0)
+    else:
+        z0 = jnp.sum(updates * msk[:, None], axis=0) / jnp.maximum(
+            jnp.sum(msk), 1.0
+        )
     obj0 = jnp.sum(alphas0 * dists(z0))
 
     def cond(carry):
@@ -48,6 +66,8 @@ def weiszfeld(
         i, z, alphas, obj, _ = carry
         d = dists(z)
         w = jnp.maximum(eps, alphas / jnp.maximum(eps, d))
+        if msk is not None:
+            w = w * msk
         w = w / jnp.sum(w)
         z_new = w @ updates
         obj_new = jnp.sum(w * dists(z_new))
@@ -74,3 +94,15 @@ class Geomed(Aggregator):
             ftol=self.ftol,
         )
         return z, state
+
+    def _masked_aggregate(self, updates, state, *, mask, weights=None, **ctx):
+        z = weiszfeld(
+            updates,
+            init_weights=weights,
+            maxiter=self.maxiter,
+            eps=self.eps,
+            ftol=self.ftol,
+            mask=mask,
+        )
+        n = jnp.sum(mask.astype(updates.dtype))
+        return jnp.where(n > 0, z, jnp.zeros_like(z)), state

@@ -64,6 +64,57 @@ class Krum(Aggregator):
         # follow the paper — a sum would scale the pseudo-gradient by m.
         return jnp.mean(updates[top_m], axis=0), state
 
+    def _masked_scores(self, updates, mask):
+        """Krum scores over the participating subset: pair distances to
+        masked-out rows are sentineled to ``+inf`` (they sort past every
+        real neighbor), each participant sums its ``n - f - 2`` nearest
+        participant distances (``n`` = traced participant count), and
+        masked-out rows score ``+inf`` so selection can never pick them.
+
+        Breakdown-point caveat (docs/robustness.md): Krum's guarantee needs
+        ``n >= 2f + 3``. Under dropout ``n`` is traced, so the static
+        reference guard can only check the full K; when dropout pushes the
+        round below the bound the neighbor count clamps at 1 and Krum
+        degrades to nearest-neighbor selection among participants rather
+        than failing the compiled program.
+        """
+        k = updates.shape[0]
+        if 2 * self.f + 2 > k:
+            raise ValueError(f"Too many Byzantine workers: 2*{self.f}+2 > {k}")
+        n = jnp.sum(mask.astype(jnp.int32))
+        d2 = pairwise_sq_euclidean(updates)
+        if self.distance_power == 4:
+            d2 = d2 * d2
+        pair_ok = mask[:, None] & mask[None, :]
+        eye = jnp.eye(k, dtype=bool)
+        d2 = jnp.where(pair_ok & ~eye, d2, jnp.inf)
+        s = jnp.sort(d2, axis=1)
+        nn = jnp.maximum(n - self.f - 2, 1)
+        # drop the +inf sentinels from the sum as well as ranks past nn:
+        # when n is so low that a participant has fewer real neighbors than
+        # nn (n=1: none at all), its score stays FINITE — strictly below
+        # every masked-out row's +inf, so selection still prefers
+        # participants instead of tying at inf with zeroed absent rows.
+        # All-ones: every kept prefix entry is finite (the lone inf per row
+        # is the self-distance, sorted last), so the filter is a no-op.
+        keep = (jnp.arange(k)[None, :] < nn) & jnp.isfinite(s)
+        scores = jnp.sum(jnp.where(keep, s, 0.0), axis=1)
+        return jnp.where(mask, scores, jnp.inf), n
+
+    def _masked_aggregate(self, updates, state, *, mask, **ctx):
+        scores, n = self._masked_scores(updates, mask)
+        top_m = jnp.argsort(scores)[: self.m]
+        # fewer participants than m: weight only the first min(m, n) ranks
+        # (non-participants score +inf and sort last, so they never land in
+        # the weighted prefix). The mean-then-rescale form keeps the full-
+        # participation case bit-identical to the unmasked jnp.mean (the
+        # rescale is exactly *1.0 when m_eff == m).
+        m_eff = jnp.minimum(self.m, jnp.maximum(n, 1))
+        w = (jnp.arange(self.m) < m_eff).astype(updates.dtype)
+        sel = updates[top_m] * w[:, None]
+        scale = jnp.asarray(self.m, updates.dtype) / m_eff.astype(updates.dtype)
+        return jnp.mean(sel, axis=0) * scale, state
+
     def diagnostics(self, updates, state=(), **ctx):
         """Forensics: the full per-client score vector and the ``m``
         selected client indices — which clients the defense trusted this

@@ -5,6 +5,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.ops.masked import masked_mean
 
 
 class Mean(Aggregator):
@@ -12,3 +13,6 @@ class Mean(Aggregator):
 
     def aggregate(self, updates, state=(), **ctx):
         return jnp.mean(updates, axis=0), state
+
+    def _masked_aggregate(self, updates, state, *, mask, **ctx):
+        return masked_mean(updates, mask), state

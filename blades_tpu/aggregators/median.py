@@ -11,8 +11,13 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.ops.masked import masked_median
 
 
 class Median(Aggregator):
     def aggregate(self, updates, state=(), **ctx):
         return jnp.median(updates, axis=0), state
+
+    def _masked_aggregate(self, updates, state, *, mask, **ctx):
+        # sentinel sort over the participating subset (ops/masked.py)
+        return masked_median(updates, mask), state

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from blades_tpu.aggregators.base import Aggregator
 from blades_tpu.ops.clustering import complete_linkage_two_clusters
+from blades_tpu.ops.masked import masked_median_1d
 
 
 class Signguard(Aggregator):
@@ -27,9 +28,21 @@ class Signguard(Aggregator):
         self.upper = upper
 
     def aggregate(self, updates, state=(), **ctx):
+        return self._aggregate_impl(updates, state, None)
+
+    def _masked_aggregate(self, updates, state, *, mask, **ctx):
+        return self._aggregate_impl(updates, state, mask)
+
+    def _aggregate_impl(self, updates, state, mask):
+        """``mask=None`` is the full-population program. Under partial
+        participation the norm statistics and the majority vote run over
+        participants only; absent rows enter the sign-feature linkage at
+        zero distance to everyone (neutral for complete linkage — see
+        ``Clustering._masked_aggregate``) and are excluded from the final
+        average."""
         k = updates.shape[0]
         norms = jnp.sqrt(jnp.maximum(jnp.sum(updates**2, axis=1), 1e-24))
-        med = jnp.median(norms)
+        med = jnp.median(norms) if mask is None else masked_median_1d(norms, mask)
         norm_ok = (norms >= self.lower * med) & (norms <= self.upper * med)
 
         sign = jnp.sign(updates)
@@ -46,12 +59,22 @@ class Signguard(Aggregator):
                 jnp.sum((feats[:, None, :] - feats[None, :, :]) ** 2, axis=-1), 0.0
             )
         )
+        if mask is not None:
+            out_pair = (~mask[:, None] | ~mask[None, :]) & ~jnp.eye(k, dtype=bool)
+            dist = jnp.where(out_pair, 0.0, dist)
         labels = complete_linkage_two_clusters(dist)
-        size1 = jnp.sum(labels)
-        majority = jnp.where(size1 > k - size1, 1, 0)
+        if mask is None:
+            size1 = jnp.sum(labels)
+            majority = jnp.where(size1 > k - size1, 1, 0)
+        else:
+            mi = mask.astype(labels.dtype)
+            size1 = jnp.sum(mi * labels)
+            majority = jnp.where(size1 > jnp.sum(mi) - size1, 1, 0)
         sign_ok = labels == majority
 
         keep = (norm_ok & sign_ok).astype(updates.dtype)
+        if mask is not None:
+            keep = keep * mask.astype(updates.dtype)
         clip = jnp.minimum(1.0, med / norms)
         clipped = updates * clip[:, None]
         return (keep @ clipped) / jnp.maximum(jnp.sum(keep), 1.0), state

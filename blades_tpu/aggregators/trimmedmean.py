@@ -13,6 +13,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.ops.masked import masked_trimmed_mean
 from blades_tpu.ops.pallas_trimmed import trimmed_mean
 
 
@@ -31,6 +32,13 @@ class Trimmedmean(Aggregator):
 
     def aggregate(self, updates, state=(), **ctx):
         return trimmed_mean(updates, self._effective_b(updates.shape[0])), state
+
+    def _masked_aggregate(self, updates, state, *, mask, **ctx):
+        # rank-mask trim over the participating subset; b additionally
+        # clamps against the traced participant count (under dropout the
+        # trim narrows toward the masked median instead of dying)
+        b = self._effective_b(updates.shape[0])
+        return masked_trimmed_mean(updates, mask, b), state
 
     def diagnostics(self, updates, state=(), **ctx):
         """Forensics: per-client count of coordinates where that client's

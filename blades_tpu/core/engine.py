@@ -33,6 +33,7 @@ from jax import lax
 
 from blades_tpu.aggregators.base import Aggregator
 from blades_tpu.attackers.base import Attack, NoAttack
+from blades_tpu.faults import FaultModel
 from blades_tpu.ops.pytree import make_unraveler, ravel
 from blades_tpu.parallel.mesh import ShardingPlan
 from blades_tpu.telemetry import get_recorder
@@ -106,6 +107,10 @@ class RoundState(NamedTuple):
     agg_state: Any
     attack_state: Any
     round_idx: jnp.ndarray  # scalar int32
+    # stale-update replay buffer etc. (blades_tpu.faults), () when no fault
+    # model is installed — checkpointed with everything else so a resumed
+    # run replays the exact straggler history
+    fault_state: Any = ()
 
 
 class RoundMetrics(NamedTuple):
@@ -147,6 +152,7 @@ class RoundEngine:
         keep_updates: bool = True,
         donate_batches: bool = False,
         collect_diagnostics: bool = False,
+        fault_model: Optional[FaultModel] = None,
     ):
         """``client_chunks``: split the K client axis into this many
         sequential chunks (``lax.map`` outside, vmap inside). Each chunk still
@@ -181,7 +187,16 @@ class RoundEngine:
         trim-mask summaries, trust scores) into the round program and
         expose it per round as ``self.last_diagnostics``. Static branch,
         off by default: some diagnostics (trimmed-mean's rank mask) cost
-        work the aggregate itself does not need."""
+        work the aggregate itself does not need.
+
+        ``fault_model``: a :class:`blades_tpu.faults.FaultModel` injecting
+        system faults (dropout / stale straggler replays / payload
+        corruption) into the round as masks inside the same compiled
+        program; aggregation then runs through the mask-aware
+        ``Aggregator.aggregate_masked`` surface over the participating
+        subset, and per-round fault counters land in
+        ``self.last_fault_diag``. ``None`` (default) compiles the exact
+        pre-fault program."""
         self.train_loss_fn = train_loss_fn
         self.eval_logits_fn = eval_logits_fn
         self.num_clients = int(num_clients)
@@ -203,6 +218,8 @@ class RoundEngine:
         self.keep_updates = bool(keep_updates)
         self.collect_diagnostics = bool(collect_diagnostics)
         self.last_diagnostics: Any = None
+        self.fault_model = fault_model
+        self.last_fault_diag: Any = None
 
         self.dim, self.unravel = make_unraveler(params_template)
         # Reference convention: the FIRST num_byzantine client ids are
@@ -239,6 +256,11 @@ class RoundEngine:
             else ()
         )
         attack_state = self.attack.init_state(self.num_clients, self.dim)
+        fault_state = (
+            self.fault_model.init_state(self.num_clients, self.dim)
+            if self.fault_model is not None
+            else ()
+        )
         state = RoundState(
             params=params,
             server_opt_state=server_opt_state,
@@ -246,6 +268,7 @@ class RoundEngine:
             agg_state=agg_state,
             attack_state=attack_state,
             round_idx=jnp.asarray(0, jnp.int32),
+            fault_state=fault_state,
         )
         return self.place_state(state)
 
@@ -405,6 +428,20 @@ class RoundEngine:
             updates, self.byz_mask, attack_key, state.attack_state
         )
 
+        # system-fault injection (static branch — without a fault model the
+        # compiled program is exactly the pre-fault one). The variance
+        # metrics below stay on the matrix the clients SENT: corrupted/
+        # replayed payloads surface in fault_diag, not by NaN-ing metrics.
+        sent_updates = updates
+        fault_state = state.fault_state
+        part_mask = None
+        fault_diag = {}
+        if self.fault_model is not None:
+            fault_key = jax.random.fold_in(round_key, rng.FAULT)
+            updates, part_mask, fault_state, fault_diag = self.fault_model.apply(
+                updates, fault_state, fault_key, state.round_idx
+            )
+
         agg_ctx = dict(
             trusted_mask=self.trusted_mask,
             # current flat params for defenses that track the model
@@ -413,17 +450,32 @@ class RoundEngine:
             params_flat=ravel(state.params),
             key=jax.random.fold_in(round_key, rng.AGG),
         )
+        if part_mask is not None:
+            agg_ctx["mask"] = part_mask
+            call = (
+                self.aggregator.aggregate_masked_with_diagnostics
+                if self.collect_diagnostics
+                else self.aggregator.aggregate_masked
+            )
+        else:
+            call = (
+                self.aggregator.aggregate_with_diagnostics
+                if self.collect_diagnostics
+                else self.aggregator.aggregate
+            )
         if self.collect_diagnostics:
             # static branch: forensic pytree (selection indices, trim masks,
             # trust scores) traced alongside the aggregate
-            agg, agg_state, agg_diag = self.aggregator.aggregate_with_diagnostics(
-                updates, state.agg_state, **agg_ctx
-            )
+            agg, agg_state, agg_diag = call(updates, state.agg_state, **agg_ctx)
         else:
-            agg, agg_state = self.aggregator.aggregate(
-                updates, state.agg_state, **agg_ctx
-            )
+            agg, agg_state = call(updates, state.agg_state, **agg_ctx)
             agg_diag = {}
+        if part_mask is not None:
+            # graceful skip: a round with zero participants applies the zero
+            # pseudo-gradient instead of whatever an empty reduction yields
+            agg = jnp.where(
+                jnp.sum(part_mask.astype(jnp.int32)) > 0, agg, jnp.zeros_like(agg)
+            )
 
         # server pseudo-gradient step: grad := -agg (server.py:54-75)
         grad_tree = self.unravel(-agg)
@@ -440,7 +492,7 @@ class RoundEngine:
         n_honest = jnp.maximum(honest.sum(), 1.0)
         # variance stats mirror the reference's log_variance
         # (simulator.py:309-322): population variance over client updates
-        var = updates.var(axis=0)
+        var = sent_updates.var(axis=0)
         metrics = RoundMetrics(
             train_loss=(losses * honest).sum() / n_honest,
             train_loss_all=losses.mean(),
@@ -456,10 +508,19 @@ class RoundEngine:
             agg_state=agg_state,
             attack_state=attack_state,
             round_idx=state.round_idx + 1,
+            fault_state=fault_state,
         )
         # static branch: when the caller never reads the matrix, don't make
-        # it a program output (outputs persist in HBM across rounds)
-        return new_state, metrics, updates if self.keep_updates else (), agg_diag
+        # it a program output (outputs persist in HBM across rounds). Under
+        # a fault model the output is the matrix the server RECEIVED (stale
+        # replays / corruption applied) — what observers should see.
+        return (
+            new_state,
+            metrics,
+            updates if self.keep_updates else (),
+            agg_diag,
+            fault_diag,
+        )
 
     def run_round(
         self,
@@ -484,7 +545,7 @@ class RoundEngine:
         measures trace+enqueue cost, NOT device execution — callers that
         want the device wall time block inside their own span."""
         with get_recorder().span("dispatch"):
-            new_state, metrics, updates, agg_diag = self._round_jit(
+            new_state, metrics, updates, agg_diag, fault_diag = self._round_jit(
                 state,
                 cx,
                 cy,
@@ -494,6 +555,7 @@ class RoundEngine:
             )
         self.last_updates = updates if self.keep_updates else None
         self.last_diagnostics = agg_diag if self.collect_diagnostics else None
+        self.last_fault_diag = fault_diag if self.fault_model is not None else None
         return new_state, metrics
 
     # -- evaluation ----------------------------------------------------------

@@ -1,0 +1,15 @@
+"""Fault injection + graceful degradation for federated rounds.
+
+:class:`FaultModel` injects system faults (client dropout, stale straggler
+replays, NaN/Inf/bit-flip payload corruption) into the jitted round as
+masks/``where``\\s; the mask-aware aggregation surface
+(``Aggregator.aggregate_masked``) and the server-side non-finite guard let
+every defense survive what the model injects. See ``docs/robustness.md``.
+
+Reference counterpart: none — the reference models adversarial failure only
+(``src/blades/simulator.py:213-244``); system faults are new surface.
+"""
+
+from blades_tpu.faults.model import FaultModel
+
+__all__ = ["FaultModel"]

@@ -1,0 +1,208 @@
+"""Seeded, jit-compatible system-fault injection for the federated round.
+
+Byzantine attacks (``blades_tpu/attackers``) model *adversarial* failure;
+this module models the *system* faults that dominate real deployments —
+client dropout, stragglers replaying stale updates, and corrupted payloads
+(NaN/Inf rows, bit-flip-style noise) — plus the server-side non-finite
+guard that keeps them from poisoning the global model. Everything is
+expressed as masks and ``where``\\s over the on-device ``[K, D]`` update
+matrix inside the jitted round program (``core/engine.py``): no Python-side
+branching, so the sharded round stays one compiled XLA program and every
+fault draw is a pure function of ``(seed, round)`` — reproducible and
+therefore bit-exactly resumable from a checkpoint.
+
+Reference counterpart: none — the reference simulator trains every client
+every round and assumes every upload is well-formed
+(``src/blades/simulator.py:213-244``); it has no dropout, staleness, or
+payload-fault surface at all. Partial participation semantics follow the
+FedAvg client-subsampling setting (McMahan et al., 2017) and the
+robustness-under-subsampling analysis of Karimireddy et al., 2022.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultModel:
+    """Per-round fault plan: who participates, who is stale, what is corrupt.
+
+    Construction-time hyperparameters are static under jit (the model object
+    rides on the engine like an :class:`~blades_tpu.aggregators.Aggregator`);
+    all randomness flows from the engine's round key through the dedicated
+    ``rng.FAULT`` stream, so a resumed run replays the exact fault history.
+
+    Parameters
+    ----------
+    dropout_rate : i.i.d. per-client probability of dropping out each round.
+    participation_schedule : optional ``[period, K]`` bool array — a
+        deterministic participation plan (row ``r % period`` is round ``r``'s
+        availability mask). Overrides ``dropout_rate`` when given.
+    straggler_rate : probability a (non-dropped) client is a straggler this
+        round. A straggler re-sends its buffered update from the last round
+        it reported fresh (bounded stale-update buffer carried in
+        ``RoundState.fault_state``); once the buffered update is older than
+        ``max_staleness`` rounds — or the client never reported — the
+        straggler is dropped instead of replaying arbitrarily stale state.
+    max_staleness : staleness bound (rounds) on the replay buffer.
+    corrupt_rate : i.i.d. probability a *delivered* update row is corrupted.
+    corrupt_clients : static client ids whose delivered rows are ALWAYS
+        corrupted (deterministic faulty hardware).
+    corrupt_mode : ``"nan"`` | ``"inf"`` | ``"bitflip"``. ``nan``/``inf``
+        overwrite the whole row; ``bitflip`` flips the sign and scales by
+        ``bitflip_scale`` on a random ``bitflip_frac`` of coordinates
+        (exponent-bit-flip shaped noise, still finite).
+    guard_nonfinite : server-side guard — rows containing any NaN/Inf are
+        excluded from the participation mask before aggregation (the
+        aggregator then never touches the poisoned payload). Exclusion
+        counts surface in the per-round fault diagnostics.
+    """
+
+    dropout_rate: float = 0.0
+    participation_schedule: Optional[Any] = None
+    straggler_rate: float = 0.0
+    max_staleness: int = 1
+    corrupt_rate: float = 0.0
+    corrupt_clients: Tuple[int, ...] = ()
+    corrupt_mode: str = "nan"
+    bitflip_scale: float = 2.0 ** 15
+    bitflip_frac: float = 0.01
+    guard_nonfinite: bool = True
+
+    def __post_init__(self):
+        if self.corrupt_mode not in ("nan", "inf", "bitflip"):
+            raise ValueError(f"unknown corrupt_mode {self.corrupt_mode!r}")
+        if self.participation_schedule is not None:
+            sched = np.asarray(self.participation_schedule, dtype=bool)
+            if sched.ndim != 2:
+                raise ValueError(
+                    "participation_schedule must be [period, num_clients]"
+                )
+            object.__setattr__(self, "participation_schedule", sched)
+        object.__setattr__(
+            self, "corrupt_clients", tuple(int(c) for c in self.corrupt_clients)
+        )
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def has_stragglers(self) -> bool:
+        return self.straggler_rate > 0.0
+
+    def init_state(self, num_clients: int, dim: int) -> Any:
+        """Stale-update replay buffer (empty pytree when stragglers are off,
+        so fault-free configs pay nothing in state/checkpoint size)."""
+        if not self.has_stragglers:
+            return ()
+        return {
+            "stale": jnp.zeros((num_clients, dim), jnp.float32),
+            "age": jnp.zeros((num_clients,), jnp.int32),
+            "has": jnp.zeros((num_clients,), bool),
+        }
+
+    # -- the in-graph fault pass ----------------------------------------------
+
+    def apply(
+        self, updates: jnp.ndarray, state: Any, key: jax.Array, round_idx
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, Any, dict]:
+        """Inject this round's faults into the post-attack update matrix.
+
+        Returns ``(updates, participation_mask, new_state, diagnostics)``:
+        the (possibly corrupted / stale-replayed) matrix, the boolean ``[K]``
+        mask of rows the server actually aggregates, the advanced replay
+        buffer, and a dict of int32 fault counters (participants, dropped,
+        stale replays, stragglers dropped for exceeding ``max_staleness``,
+        corrupted rows, rows excluded by the non-finite guard).
+        """
+        k = updates.shape[0]
+        kd, ks, kc, kb = jax.random.split(key, 4)
+
+        if self.participation_schedule is not None:
+            sched = jnp.asarray(self.participation_schedule)
+            drop = ~sched[jnp.mod(round_idx, sched.shape[0])]
+        elif self.dropout_rate > 0.0:
+            drop = jax.random.bernoulli(kd, self.dropout_rate, (k,))
+        else:
+            drop = jnp.zeros((k,), bool)
+
+        if self.has_stragglers:
+            straggle = jax.random.bernoulli(ks, self.straggler_rate, (k,)) & ~drop
+            age = state["age"] + 1  # buffered update ages one round
+            stale_ok = straggle & state["has"] & (age <= self.max_staleness)
+            fresh = ~drop & ~straggle
+            out = jnp.where(
+                stale_ok[:, None], state["stale"].astype(updates.dtype), updates
+            )
+            part = fresh | stale_ok
+            new_state = {
+                "stale": jnp.where(
+                    fresh[:, None], updates.astype(jnp.float32), state["stale"]
+                ),
+                "age": jnp.where(fresh, 0, age),
+                "has": state["has"] | fresh,
+            }
+            n_stale = jnp.sum(stale_ok.astype(jnp.int32))
+            n_expired = jnp.sum((straggle & ~stale_ok).astype(jnp.int32))
+        else:
+            fresh = ~drop
+            part = fresh
+            out = updates
+            new_state = state
+            n_stale = n_expired = jnp.asarray(0, jnp.int32)
+
+        corrupt = jnp.zeros((k,), bool)
+        if self.corrupt_rate > 0.0:
+            corrupt |= jax.random.bernoulli(kc, self.corrupt_rate, (k,))
+        if self.corrupt_clients:
+            ids = jnp.asarray(self.corrupt_clients, jnp.int32)
+            corrupt |= jnp.any(
+                jnp.arange(k, dtype=jnp.int32)[:, None] == ids[None, :], axis=1
+            )
+        corrupt &= part  # only delivered payloads can arrive corrupted
+        if self.corrupt_mode == "nan":
+            out = jnp.where(corrupt[:, None], jnp.nan, out)
+        elif self.corrupt_mode == "inf":
+            out = jnp.where(corrupt[:, None], jnp.inf, out)
+        else:  # bitflip: sign-flip + power-of-two scale on a coord subset
+            flip = jax.random.bernoulli(kb, self.bitflip_frac, out.shape)
+            flipped = jnp.where(flip, -self.bitflip_scale * out, out)
+            out = jnp.where(corrupt[:, None], flipped, out)
+
+        excluded = jnp.zeros((k,), bool)
+        if self.guard_nonfinite:
+            finite = jnp.all(jnp.isfinite(out), axis=1)
+            excluded = part & ~finite
+            part = part & finite
+
+        diag = {
+            "participants": jnp.sum(part.astype(jnp.int32)),
+            "dropped": jnp.sum(drop.astype(jnp.int32)),
+            "stale_replayed": n_stale,
+            "stragglers_expired": n_expired,
+            "corrupted": jnp.sum(corrupt.astype(jnp.int32)),
+            "excluded_nonfinite": jnp.sum(excluded.astype(jnp.int32)),
+        }
+        return out, part, new_state, diag
+
+    def __repr__(self) -> str:
+        parts = []
+        if self.participation_schedule is not None:
+            parts.append(f"schedule[{self.participation_schedule.shape[0]}]")
+        elif self.dropout_rate:
+            parts.append(f"drop={self.dropout_rate}")
+        if self.straggler_rate:
+            parts.append(
+                f"straggle={self.straggler_rate}(s<={self.max_staleness})"
+            )
+        if self.corrupt_rate or self.corrupt_clients:
+            parts.append(
+                f"corrupt[{self.corrupt_mode}]="
+                f"{self.corrupt_rate or list(self.corrupt_clients)}"
+            )
+        return f"FaultModel({', '.join(parts) or 'noop'})"

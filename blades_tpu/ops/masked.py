@@ -1,0 +1,83 @@
+"""Masked (participation-aware) reductions over the ``[K, D]`` update matrix.
+
+Building blocks for graceful degradation under partial participation
+(``blades_tpu/faults``): every reduction here takes a boolean ``[K]``
+participation mask and computes the statistic over the participating subset
+only — with **static shapes** (jit/SPMD-safe), via sentinel sorting and
+rank masks instead of data-dependent gathers.
+
+Bit-compatibility contract (pinned by ``tests/test_faults.py``): with an
+all-ones mask every helper reproduces the corresponding unmasked reduction
+bit-exactly — masked terms enter sums only as exact identities (``x * 1.0``,
+``x + 0.0``, ``where(True, x, _)``), divisors carry the same value, and
+rank masks reproduce the unmasked tie-breaking (stable argsort == dropping
+sorted elements).
+
+Reference counterpart: none — the reference aggregates a fixed, always-
+present client population (``src/blades/simulator.py:244``); its only
+partial-participation surface is the unreachable ``_BaseAsyncAggregator``
+family (``aggregators/mean.py:42-87``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def participant_count(mask: jnp.ndarray) -> jnp.ndarray:
+    """Number of participating clients, int32 scalar."""
+    return jnp.sum(mask.astype(jnp.int32))
+
+
+def masked_mean(updates: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Row-mean over participating rows; zero vector when none participate."""
+    m = mask.astype(updates.dtype)
+    n = jnp.sum(m)
+    return jnp.sum(updates * m[:, None], axis=0) / jnp.maximum(n, 1.0)
+
+
+def masked_median(updates: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Coordinate-wise median over participating rows (numpy midpoint
+    convention for even counts), via sentinel sort: masked-out rows are
+    pushed to ``+inf`` so the first ``n`` order statistics per coordinate
+    are exactly the participants'."""
+    n = participant_count(mask)
+    s = jnp.sort(jnp.where(mask[:, None], updates, jnp.inf), axis=0)
+    lo = s[jnp.maximum((n - 1) // 2, 0)]
+    hi = s[jnp.maximum(n // 2, 0)]
+    mid = (lo + hi) / 2.0
+    return jnp.where(n > 0, mid, jnp.zeros_like(mid))
+
+
+def masked_median_1d(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Scalar median of the participating entries of a ``[K]`` vector."""
+    return masked_median(values[:, None], mask)[0]
+
+
+def masked_trimmed_mean(
+    updates: jnp.ndarray, mask: jnp.ndarray, b: int
+) -> jnp.ndarray:
+    """Coordinate-wise trimmed mean over participating rows.
+
+    Rank-mask formulation: per coordinate, rank the participants (masked-out
+    rows sentineled to ``+inf`` rank past them), drop the ``b_eff`` smallest
+    and largest ranks among the ``n`` participants, and mean the survivors —
+    summed in ROW order, matching the survivor-sum of the unmasked
+    extraction kernel (``ops/pallas_trimmed.py:_trim_survivor_mean``)
+    bit-exactly when the mask is all ones.
+
+    Graceful degradation: ``b`` (static, pre-shrunk against the full K) is
+    further clamped to the traced participant count so ``n - 2*b_eff >= 1``
+    whenever ``n >= 1`` — under heavy dropout the trim narrows toward the
+    masked median instead of trimming the population to nothing.
+    """
+    k = updates.shape[0]
+    n = participant_count(mask)
+    b_eff = jnp.minimum(jnp.asarray(b, jnp.int32), jnp.maximum((n - 1) // 2, 0))
+    sentinel = jnp.where(mask[:, None], updates, jnp.inf)
+    # rank of each row per coordinate among ascending values (stable: ties
+    # broken by row index, same survivors-by-value as dropping sorted slots)
+    ranks = jnp.argsort(jnp.argsort(sentinel, axis=0), axis=0)
+    keep = (ranks >= b_eff) & (ranks < n - b_eff)
+    denom = jnp.maximum(n - 2 * b_eff, 1).astype(updates.dtype)
+    return jnp.sum(jnp.where(keep, updates, 0.0), axis=0) / denom

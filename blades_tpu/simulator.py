@@ -33,6 +33,7 @@ from blades_tpu.core import ClientOptSpec, RoundEngine, ServerOptSpec
 from blades_tpu.core.engine import multistep_lr
 from blades_tpu.datasets.base import BaseDataset
 from blades_tpu.datasets.fl import FLDataset
+from blades_tpu.faults import FaultModel
 from blades_tpu.models.common import ModelSpec, build_fns
 from blades_tpu.parallel.mesh import auto_mesh_shape, make_mesh, make_plan
 from blades_tpu.server import BladesServer
@@ -298,6 +299,7 @@ class Simulator:
         on_round_end: Optional[Callable] = None,
         donate_batches: bool = False,
         collect_diagnostics: Optional[bool] = None,
+        fault_model: Optional[Union[FaultModel, Dict]] = None,
     ) -> List[float]:
         """Run adversarial training; returns per-round wall times (reference
         ``run`` contract, ``simulator.py:364-457``).
@@ -321,6 +323,15 @@ class Simulator:
         (Krum selections, trim masks, trust scores) into the round program
         and log per-round ``defense`` records to the telemetry trace;
         default: the ``BLADES_TELEMETRY_DIAG=1`` env knob.
+        ``fault_model``: a :class:`blades_tpu.faults.FaultModel` (or a
+        kwargs dict for one) injecting system faults — client dropout,
+        stale straggler replays, NaN/Inf/bit-flip payload corruption — into
+        every round; aggregation runs mask-aware over the participating
+        subset, per-round fault/exclusion counts land in the telemetry
+        trace (``faults`` records), and any mid-run exception
+        auto-checkpoints the state (to ``checkpoint_path``, or
+        ``<log_path>/autosave`` when none is set) so ``resume=True``
+        restarts bit-exactly. See ``docs/robustness.md``.
 
         Telemetry (``docs/observability.md``): unless ``BLADES_TELEMETRY=0``,
         a span/counter trace of the run is appended to
@@ -339,6 +350,8 @@ class Simulator:
         profile_dir = profile_dir or os.environ.get(
             "BLADES_TELEMETRY_PROFILE_DIR"
         ) or None
+        if isinstance(fault_model, dict):
+            fault_model = FaultModel(**fault_model)
         rec = Recorder(
             path=os.path.join(self.log_path, "telemetry.jsonl"),
             meta={
@@ -349,6 +362,11 @@ class Simulator:
                 "aggregator": repr(self.aggregator),
                 "global_rounds": global_rounds,
                 "local_steps": local_steps,
+                **(
+                    {"fault_model": repr(fault_model)}
+                    if fault_model is not None
+                    else {}
+                ),
             },
         )
         self.telemetry = rec
@@ -394,14 +412,26 @@ class Simulator:
             keep_updates=retain_updates or on_round_end is not None,
             donate_batches=donate_batches,
             collect_diagnostics=collect_diagnostics,
+            fault_model=fault_model,
         )
         state = self.engine.init(params)
 
+        # crash-autosave target: the explicit checkpoint path when given,
+        # else a fixed path in the log dir — a mid-run exception (OOM, XLA
+        # abort, Ctrl-C on a hung compile) must leave a resumable state, not
+        # lose hours of rounds
+        autosave_path = checkpoint_path or os.path.join(self.log_path, "autosave")
+
         start_round = 1
-        if resume and checkpoint_path and os.path.exists(checkpoint_file(checkpoint_path)):
-            state = self.engine.place_state(restore_state(checkpoint_path, state))
-            start_round = int(state.round_idx) + 1
-            self.debug_logger.info(f"resumed from {checkpoint_path} at round {start_round}")
+        if resume:
+            for cand in dict.fromkeys((checkpoint_path, autosave_path)):
+                if cand and os.path.exists(checkpoint_file(cand)):
+                    state = self.engine.place_state(restore_state(cand, state))
+                    start_round = int(state.round_idx) + 1
+                    self.debug_logger.info(
+                        f"resumed from {cand} at round {start_round}"
+                    )
+                    break
         self.server = BladesServer(self.engine, state, self.aggregator)
 
         client_lr_fn = self._resolve_schedule(client_lr_scheduler, client_lr)
@@ -441,6 +471,7 @@ class Simulator:
                     self.log_train(rnd, local_steps, m)
                     self.log_variance(rnd, m)
                     self._log_defense(rnd)
+                    self._log_faults(rnd)
                     if retain_updates:
                         # populate reference-parity client.get_update() views
                         for i, c in enumerate(self.get_clients()):
@@ -485,6 +516,42 @@ class Simulator:
                     f"E={rnd}; Client learning rate = {c_lr}; "
                     f"Time cost = {time.time() - global_start}"
                 )
+            # the run completed: a leftover CRASH autosave (implicit path
+            # only — never a user-configured checkpoint) is now stale, and
+            # a later resume=True must not silently re-train from it
+            if checkpoint_path is None:
+                try:
+                    stale = checkpoint_file(autosave_path)
+                    if os.path.exists(stale):
+                        os.unlink(stale)
+                        self.debug_logger.info(
+                            f"run complete: removed stale crash autosave {stale}"
+                        )
+                except OSError:
+                    pass
+        except BaseException as e:  # noqa: BLE001 - incl. KeyboardInterrupt
+            # auto-checkpoint on ANY mid-run failure: `state` is the last
+            # fully completed round's state (the assignment happens only
+            # after run_round returns), so the save is always consistent.
+            # Best-effort — a poisoned device buffer must not mask the
+            # original exception with a save error.
+            try:
+                with rec.span("crash_checkpoint"):
+                    save_state(autosave_path, state)
+                rec.event(
+                    "crash_checkpoint",
+                    path=checkpoint_file(autosave_path),
+                    round=int(state.round_idx),
+                    error=f"{type(e).__name__}: {e}"[:300],
+                )
+                self.debug_logger.info(
+                    f"crash at round {len(round_times) + start_round}: state "
+                    f"auto-checkpointed to {checkpoint_file(autosave_path)}; "
+                    "rerun with resume=True to continue bit-exactly"
+                )
+            except Exception as save_err:  # noqa: BLE001
+                rec.event("crash_checkpoint_failed", error=str(save_err)[:300])
+            raise
         finally:
             # also reached when a round raises (OOM, XLA abort, Ctrl-C on a
             # hung compile): whatever was recorded up to the failure reaches
@@ -606,6 +673,21 @@ class Simulator:
         self.telemetry.event(
             "defense", round=rnd, agg=repr(self.aggregator), **fields, **overlap
         )
+
+    def _log_faults(self, rnd: int) -> None:
+        """Fault-injection forensics -> one ``faults`` telemetry record per
+        round: participants, dropouts, stale replays, expired stragglers,
+        corrupted payloads, and non-finite exclusions (``blades_tpu.faults``
+        diagnostics). The counts also land as gauges so every ``round``
+        record carries the latest values. Reference counterpart: none — the
+        reference has no system-fault surface."""
+        diag = getattr(self.engine, "last_fault_diag", None)
+        if not diag or not self.telemetry.enabled:
+            return
+        fields = {name: int(np.asarray(v)) for name, v in diag.items()}
+        for name, value in fields.items():
+            self.telemetry.gauge(f"faults.{name}", value)
+        self.telemetry.event("faults", round=rnd, **fields)
 
     def evaluate(self, rnd: int, batch_size: int = 64) -> Dict:
         """Reference test flow (``test_actor`` -> ``log_validate``,

@@ -28,26 +28,59 @@ def checkpoint_file(path: str) -> str:
 
 
 def save_state(path: str, state: Any) -> None:
-    """Serialize a pytree (e.g. RoundState) to ``checkpoint_file(path)``."""
+    """Serialize a pytree (e.g. RoundState) to ``checkpoint_file(path)``.
+
+    Atomic: the archive is written to ``<path>.tmp`` and moved into place
+    with ``os.replace`` (atomic on POSIX), so a process killed mid-save —
+    the crash-autosave scenario this checkpoint exists for — can never
+    leave a torn file at the checkpoint path; at worst a stale ``.tmp``
+    remains next to the intact previous checkpoint.
+    """
     path = checkpoint_file(path)
     flat, treedef = jax.tree_util.tree_flatten(state)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(flat)}
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    np.savez(
-        path,
-        __treedef__=np.frombuffer(str(treedef).encode(), np.uint8),
-        __num_leaves__=np.asarray(len(flat)),
-        **arrays,
-    )
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as fh:
+            # np.savez only appends ".npz" to bare paths, not file objects
+            np.savez(
+                fh,
+                __treedef__=np.frombuffer(str(treedef).encode(), np.uint8),
+                __num_leaves__=np.asarray(len(flat)),
+                **arrays,
+            )
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def restore_state(path: str, like: Any) -> Any:
     """Restore a pytree saved by :func:`save_state`. ``like`` supplies the
     tree structure (e.g. a freshly built RoundState); the saved treedef,
-    leaf count, shapes, and dtypes must all match it."""
-    z = np.load(checkpoint_file(path))
+    leaf count, shapes, and dtypes must all match it.
+
+    A truncated or otherwise unreadable archive raises a clean
+    ``ValueError`` naming the file (atomic saves make this unreachable for
+    our own writes, but a torn copy/scp or disk corruption should fail
+    loudly, not with a zipfile traceback deep in numpy)."""
+    fname = checkpoint_file(path)
+    try:
+        z = np.load(fname)
+    except Exception as e:  # noqa: BLE001 - BadZipFile/OSError/pickle errors
+        raise ValueError(
+            f"checkpoint {fname} is corrupt or unreadable "
+            f"(truncated/torn write?): {type(e).__name__}: {e}"
+        ) from e
     flat_like, treedef = jax.tree_util.tree_flatten(like)
-    saved_n = int(z["__num_leaves__"]) if "__num_leaves__" in z else None
+    try:
+        saved_n = int(z["__num_leaves__"]) if "__num_leaves__" in z else None
+    except Exception as e:  # noqa: BLE001 - member read on a torn archive
+        raise ValueError(
+            f"checkpoint {fname} is corrupt or unreadable "
+            f"(truncated/torn write?): {type(e).__name__}: {e}"
+        ) from e
     if saved_n is not None and saved_n != len(flat_like):
         raise ValueError(
             f"checkpoint has {saved_n} leaves but the current engine state "
@@ -60,7 +93,15 @@ def restore_state(path: str, like: Any) -> Any:
             "checkpoint tree structure differs from the current engine "
             f"state:\n  saved:   {saved_treedef}\n  current: {treedef}"
         )
-    flat = [jnp.asarray(z[f"leaf_{i}"]) for i in range(len(flat_like))]
+    try:
+        flat = [jnp.asarray(z[f"leaf_{i}"]) for i in range(len(flat_like))]
+    except (KeyError, ValueError):
+        raise
+    except Exception as e:  # noqa: BLE001 - zlib/zipfile on a torn member
+        raise ValueError(
+            f"checkpoint {fname} is corrupt or unreadable "
+            f"(truncated/torn write?): {type(e).__name__}: {e}"
+        ) from e
     for i, (new, old) in enumerate(zip(flat, flat_like)):
         if jnp.shape(new) != jnp.shape(old):
             raise ValueError(

@@ -1,0 +1,68 @@
+"""Bounded-exponential-backoff retry for flaky host-side operations.
+
+The TPU attachment on this box tunnels through a helper that dies for hours
+at a time; backend acquisition then fails (or hangs) with transient
+``Unavailable``-class errors that poison nothing but the attempt itself.
+:func:`retry_call` turns such a flake into a *recorded* retry — each attempt
+increments a ``retry.<name>`` telemetry counter and emits a ``retry`` event
+on the active recorder (``blades_tpu.telemetry``, zero-dependency, safe to
+import before jax) — instead of a hung or dead run. Used by ``bench.py``'s
+backend preflight and ``scripts/tpu_capture.py``'s tunnel probe.
+
+Reference counterpart: none — the reference assumes a permanently healthy
+Ray cluster and retries nothing (``src/blades/simulator.py:189-211``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+from blades_tpu.telemetry import get_recorder
+
+T = TypeVar("T")
+
+
+def retry_call(
+    fn: Callable[[], T],
+    *,
+    attempts: int = 3,
+    base_delay: float = 1.0,
+    max_delay: float = 60.0,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    describe: str = "operation",
+    on_retry: Optional[Callable[[int, float, BaseException], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Call ``fn`` up to ``attempts`` times with exponential backoff.
+
+    Delay before retry ``i`` (1-based) is ``min(base_delay * 2**(i-1),
+    max_delay)``. Exceptions not matching ``retry_on`` — and the final
+    attempt's failure — propagate unchanged. ``on_retry(attempt, delay,
+    exc)`` runs before each sleep (logging hook); every retry is also
+    counted on the active telemetry recorder as ``retry.<describe>`` plus a
+    ``retry`` event, so a flake that self-healed still shows up in the
+    trace/bench payload instead of vanishing.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt == attempts:
+                raise
+            delay = min(base_delay * 2.0 ** (attempt - 1), max_delay)
+            rec = get_recorder()
+            rec.counter(f"retry.{describe}")
+            rec.event(
+                "retry",
+                what=describe,
+                attempt=attempt,
+                delay_s=delay,
+                error=f"{type(e).__name__}: {e}"[:300],
+            )
+            if on_retry is not None:
+                on_retry(attempt, delay, e)
+            sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

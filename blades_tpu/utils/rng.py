@@ -32,6 +32,7 @@ EVAL = 4
 # fold_in(round_key, client_id) can never collide with a purpose stream.
 CLIENTS = 5
 AGG = 6
+FAULT = 7
 
 
 def set_random_seed(seed: int = 0) -> jax.Array:

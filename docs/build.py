@@ -59,6 +59,8 @@ GALLERY = [
      {}, 900),
     ("simulation_on_mnist.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
     ("telemetry_trace.py", ["--rounds", "2", "--out", "@TMP@"], {}, 600),
+    ("fault_injection.py",
+     ["--rounds", "2", "--out", "@TMP@", "--aggs", "median"], {}, 900),
     ("fedavg_ipm.py",
      ["--rounds", "2", "--steps", "2", "--out", "@TMP@"], {}, 900),
     ("robustness_matrix.py",
@@ -79,6 +81,7 @@ API_MODULES = [
     "blades_tpu.core.engine",
     "blades_tpu.aggregators",
     "blades_tpu.attackers",
+    "blades_tpu.faults",
     "blades_tpu.datasets.fl",
     "blades_tpu.datasets.base",
     "blades_tpu.models",
@@ -88,6 +91,7 @@ API_MODULES = [
     "blades_tpu.parallel.mesh",
     "blades_tpu.parallel.distributed",
     "blades_tpu.utils.checkpoint",
+    "blades_tpu.utils.retry",
     "blades_tpu.leaf",
     "blades_tpu.leaf.preprocess",
 ]

@@ -1,0 +1,130 @@
+"""Fault injection + graceful degradation: dropout, NaN clients, crash/resume.
+
+Real federated deployments lose clients to dropout, receive stale updates
+from stragglers, and occasionally ingest NaN payloads from broken hardware.
+This demo runs that exact weather against three robust aggregators and shows
+the run surviving all of it (``docs/robustness.md``):
+
+1. a small MLP federation with **30% client dropout + 2 NaN-injecting
+   faulty clients** under each of krum / median / trimmedmean — every round
+   completes, the loss stays finite, and the per-round fault counters
+   (participants, dropouts, non-finite exclusions) are read back from the
+   telemetry trace;
+2. the same run **killed mid-flight**: the crash autosave appears in the
+   log dir and ``resume=True`` reproduces the uninterrupted run's final
+   parameters bit-exactly.
+
+The reference has no counterpart for any of this — it trains every client
+every round and assumes every upload is well-formed
+(``src/blades/simulator.py:213-244``).
+
+Usage: ``python examples/fault_injection.py [--rounds 4] [--out DIR]
+[--aggs krum median trimmedmean]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from blades_tpu.utils.platform import apply_env_platform  # noqa: E402
+
+apply_env_platform()  # honor JAX_PLATFORMS=cpu launchers (docs/build.py)
+
+
+def fault_counts(log_path):
+    """Per-round fault records from the run's telemetry trace."""
+    trace = os.path.join(log_path, "telemetry.jsonl")
+    if not os.path.exists(trace):  # BLADES_TELEMETRY=0
+        return []
+    with open(trace) as f:
+        return [r for r in map(json.loads, f) if r.get("t") == "faults"]
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=4)
+    p.add_argument("--out", default=os.path.join(REPO, "results", "faults_demo"))
+    p.add_argument("--aggs", nargs="+",
+                   default=["krum", "median", "trimmedmean"])
+    args = p.parse_args()
+
+    import numpy as np
+
+    from blades_tpu import FaultModel, Simulator
+    from blades_tpu.datasets import Synthetic
+    from blades_tpu.ops.pytree import ravel
+
+    faults = FaultModel(
+        dropout_rate=0.3,          # ~30% of clients miss any given round
+        corrupt_clients=(0, 1),    # two permanently NaN-emitting clients
+        corrupt_mode="nan",
+    )
+
+    def build(agg, sub, seed=0):
+        return Simulator(
+            dataset=Synthetic(num_clients=8, train_size=800, test_size=160,
+                              noise=0.3, cache=False),
+            aggregator=agg,
+            aggregator_kws={"num_byzantine": 2} if agg != "median" else {},
+            log_path=os.path.join(args.out, sub),
+            seed=seed,
+        )
+
+    run_kw = dict(global_rounds=args.rounds, local_steps=2, client_lr=0.2,
+                  server_lr=1.0, train_batch_size=8,
+                  validate_interval=args.rounds)
+
+    # -- 1. three defenses under dropout + NaN clients ----------------------
+    for agg in args.aggs:
+        sim = build(agg, agg)
+        sim.run("mlp", fault_model=faults, **run_kw)
+        ev = sim.evaluate(args.rounds, 64)
+        assert np.isfinite(ev["Loss"]), f"{agg}: loss went non-finite!"
+        recs = fault_counts(os.path.join(args.out, agg))
+        excl = sum(r["excluded_nonfinite"] for r in recs)
+        dropped = sum(r["dropped"] for r in recs)
+        parts = [r["participants"] for r in recs]
+        print(f"{agg:12s} loss={ev['Loss']:.4f} top1={ev['top1']:.3f}  "
+              f"participants/round={parts}  dropped={dropped} "
+              f"nan_rows_excluded={excl}")
+
+    # -- 2. kill mid-run, resume bit-exactly --------------------------------
+    agg = args.aggs[0]
+    ref_sim = build(agg, "uninterrupted", seed=3)
+    ref_sim.run("mlp", fault_model=faults, **run_kw)
+    ref = np.asarray(ravel(ref_sim.server.state.params))
+
+    kill_at = max(args.rounds // 2, 1)
+
+    def killer(rnd, state, m):
+        if rnd == kill_at:
+            raise RuntimeError("simulated mid-run kill")
+
+    crash_log = os.path.join(args.out, "crashed")
+    crash_sim = build(agg, "crashed", seed=3)
+    try:
+        crash_sim.run("mlp", fault_model=faults, on_round_end=killer, **run_kw)
+        raise AssertionError("the kill did not fire")
+    except RuntimeError:
+        pass
+    autosave = os.path.join(crash_log, "autosave.npz")
+    print(f"\nkilled at round {kill_at}; crash autosave written: "
+          f"{os.path.exists(autosave)}")
+
+    resumed = build(agg, "crashed", seed=3)  # same log dir -> same autosave
+    resumed.run("mlp", fault_model=faults, resume=True, **run_kw)
+    out = np.asarray(ravel(resumed.server.state.params))
+    exact = bool(np.array_equal(ref, out))
+    print(f"resumed rounds {kill_at + 1}..{args.rounds}; final params "
+          f"bit-identical to the uninterrupted run: {exact}")
+    assert exact, "resume was not bit-exact"
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "results", "tpu_r5")
 ROWS = os.path.join(OUT, "rows.jsonl")
 
+sys.path.insert(0, REPO)
+from blades_tpu.utils.retry import retry_call  # noqa: E402  (stdlib-only import chain)
+
 
 def log(msg):
     print(f"[capture {datetime.datetime.now(datetime.timezone.utc):%H:%M:%S}] {msg}", flush=True)
@@ -97,7 +100,28 @@ def require_tunnel():
         return
     if time.time() - _last_alive < ALIVE_TTL_S:
         return
-    if not tunnel_alive():
+
+    # bounded-backoff retry (utils/retry.py): observed 2026-07-31, the
+    # tunnel flaps on sub-minute scales — one failed probe right before an
+    # up-window must degrade to a short recorded wait, not an instant bail
+    # that throws the window away. Still bails (resumably) when the tunnel
+    # stays dead through every attempt.
+    def probe():
+        if not tunnel_alive():
+            raise RuntimeError("tunnel probe failed")
+
+    try:
+        retry_call(
+            probe,
+            attempts=int(os.environ.get("TUNNEL_PROBE_ATTEMPTS", 2)),
+            base_delay=15.0,
+            max_delay=60.0,
+            describe="tpu_tunnel",
+            on_retry=lambda a, d, e: log(
+                f"tunnel probe failed (attempt {a}), retrying in {d:.0f}s"
+            ),
+        )
+    except RuntimeError:
         log("tunnel dead — bailing (capture is resumable; watcher re-fires)")
         sys.exit(2)
 

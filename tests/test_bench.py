@@ -153,6 +153,33 @@ def test_telemetry_subdict_rides_the_one_json_line(bench, monkeypatch, capsys):
     assert code == 0 and "telemetry" not in payload
 
 
+def test_parent_crash_still_emits_one_json_line(bench, monkeypatch, capsys):
+    """The one-JSON-line contract survives a bug in the parent ladder
+    itself: an unexpected exception becomes a single parseable error line
+    with a ``stage`` field, never a traceback-only death."""
+    def explode(overrides, timeout_s):
+        raise RuntimeError("ladder bug")
+
+    monkeypatch.setattr(bench, "_run_child", explode)
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    payload = json.loads(out[0])
+    assert payload["value"] is None
+    assert payload["stage"] == "parent"
+    assert "ladder bug" in payload["error"]
+    assert payload["metric"]  # schema-complete
+
+
+def test_total_failure_carries_ladder_stage(bench, monkeypatch, capsys):
+    probe = (None, "timeout after 240s")
+    cpu = (None, "sampler: JaxRuntimeError: boom")
+    payload, _, code = run_main(bench, monkeypatch, capsys, [probe, cpu])
+    assert code == 1 and payload["stage"] == "ladder"
+
+
 def test_make_agg_signature_dispatch(bench):
     """num_byzantine is forwarded only to constructors that declare it;
     no-arg aggregators (object.__init__) must neither crash nor silently
