@@ -150,6 +150,7 @@ def child_main() -> None:
         import jax.numpy as jnp
         import numpy as np
 
+        from blades_tpu.supervision.heartbeat import beat as _beat
         from blades_tpu.telemetry import Recorder, set_recorder
         from blades_tpu.utils.xla_cache import enable_compilation_cache
 
@@ -256,6 +257,9 @@ def child_main() -> None:
                 jax.random.fold_in(key, r), local_steps, batch
             )
             state, m = engine.run_round(state, cx, cy, 0.1, 1.0, key)
+            # supervised-run liveness (no-op unless BLADES_HEARTBEAT_FILE
+            # is set by blades_tpu.supervision)
+            _beat(round_idx=r)
             return state, m
 
         stage = "warmup"

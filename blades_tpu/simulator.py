@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import logging
 import os
+import signal
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Union
 
@@ -37,12 +39,22 @@ from blades_tpu.faults import FaultModel
 from blades_tpu.models.common import ModelSpec, build_fns
 from blades_tpu.parallel.mesh import auto_mesh_shape, make_mesh, make_plan
 from blades_tpu.server import BladesServer
+from blades_tpu.supervision import heartbeat as _heartbeat
 from blades_tpu.telemetry import Recorder, install_jax_monitoring, set_recorder
 from blades_tpu.utils.checkpoint import checkpoint_file, restore_state, save_state
 from blades_tpu.utils.logging import initialize_logger
 from blades_tpu.utils.metrics import top1_accuracy
 
 _IGNORED_KWARGS = ("num_actors", "num_trainers", "gpu_per_actor", "mode", "use_cuda")
+
+
+class SupervisorTermination(BaseException):
+    """Raised in the round loop when the run supervisor SIGTERMs a
+    supervised run — derives from ``BaseException`` (like
+    ``KeyboardInterrupt``) so application-level ``except Exception``
+    handlers cannot swallow the shutdown, while the run loop's crash
+    autosave still fires before the process dies
+    (``blades_tpu/supervision``, docs/robustness.md)."""
 
 
 class _CompositeAttack(Attack):
@@ -341,10 +353,21 @@ class Simulator:
         Summarize with ``python scripts/trace_summary.py``.
         ``BLADES_TELEMETRY_PROFILE_DIR`` is an env alias for ``profile_dir``
         (a ~3-round ``jax.profiler`` capture) for real-TPU windows.
+
+        Supervision (``docs/robustness.md``): under the run supervisor
+        (``python -m blades_tpu.supervision -- ...``) the loop touches the
+        ``BLADES_HEARTBEAT_FILE`` liveness file at every round flush,
+        honors ``BLADES_RESUME=1`` as ``resume=True`` (so a relaunch
+        continues from the crash autosave), and converts the supervisor's
+        SIGTERM into :class:`SupervisorTermination` so the crash autosave
+        fires before the process group is reaped.
         """
         from blades_tpu.utils.xla_cache import enable_compilation_cache
 
         enable_compilation_cache()
+        # supervised relaunches resume without the caller threading the
+        # flag through (the supervisor restarts the same command line)
+        resume = resume or os.environ.get(_heartbeat.RESUME_ENV) == "1"
         if collect_diagnostics is None:
             collect_diagnostics = os.environ.get("BLADES_TELEMETRY_DIAG") == "1"
         profile_dir = profile_dir or os.environ.get(
@@ -352,8 +375,20 @@ class Simulator:
         ) or None
         if isinstance(fault_model, dict):
             fault_model = FaultModel(**fault_model)
+        trace_path = os.path.join(self.log_path, "telemetry.jsonl")
+        # the log-dir wipe preserves the trace for kill -> relaunch
+        # post-mortems, but a FRESH unsupervised run is a NEW experiment:
+        # starting a new trace keeps per-run consumers (trace_summary,
+        # chaos invariant checks) from double-counting a previous run's
+        # records. Supervised attempt 1 must NOT truncate — the supervisor
+        # already appended its launch record there.
+        if not resume and os.environ.get(_heartbeat.SUPERVISED_ENV) != "1":
+            try:
+                os.unlink(trace_path)
+            except OSError:
+                pass
         rec = Recorder(
-            path=os.path.join(self.log_path, "telemetry.jsonl"),
+            path=trace_path,
             meta={
                 "run": "simulator",
                 "num_clients": self.dataset.num_clients,
@@ -376,6 +411,23 @@ class Simulator:
         # — the documented tunnel-hang scenario — must still leave a trace
         # to post-mortem, not depend on surviving to the first round flush
         rec.flush()
+        # supervised runs: SIGTERM (the supervisor's first escalation step)
+        # becomes an in-loop exception so the crash autosave below fires
+        # before SIGKILL; main-thread only (signal.signal's constraint)
+        prev_sigterm = None
+        if (
+            os.environ.get(_heartbeat.SUPERVISED_ENV) == "1"
+            and threading.current_thread() is threading.main_thread()
+        ):
+            def _on_sigterm(signum, frame):
+                raise SupervisorTermination(
+                    "SIGTERM from run supervisor"
+                )
+
+            try:
+                prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+            except (ValueError, OSError):
+                prev_sigterm = None
         spec = self._model_spec(model, loss, compute_dtype)
         batch_size = train_batch_size or self._train_bs
 
@@ -432,6 +484,22 @@ class Simulator:
                         f"resumed from {cand} at round {start_round}"
                     )
                     break
+        elif checkpoint_path is None:
+            # fresh run: invalidate any leftover IMPLICIT crash autosave in
+            # this log dir NOW (the recovery-aware log-dir wipe preserves
+            # *.npz) — otherwise a supervised relaunch of THIS run
+            # (BLADES_RESUME=1) could resume from a previous experiment's
+            # stale state if this attempt dies before its first autosave.
+            # Never touches a user-configured checkpoint_path.
+            try:
+                stale = checkpoint_file(autosave_path)
+                if os.path.exists(stale):
+                    os.unlink(stale)
+                    self.debug_logger.info(
+                        f"fresh run: removed stale crash autosave {stale}"
+                    )
+            except OSError:
+                pass
         self.server = BladesServer(self.engine, state, self.aggregator)
 
         client_lr_fn = self._resolve_schedule(client_lr_scheduler, client_lr)
@@ -512,6 +580,9 @@ class Simulator:
                     train_top1=float(m.train_top1),
                 )
                 rec.flush()
+                # supervised runs: liveness beat piggybacked on the round
+                # flush (no-op when BLADES_HEARTBEAT_FILE is unset)
+                _heartbeat.beat(round_idx=rnd)
                 self.debug_logger.info(
                     f"E={rnd}; Client learning rate = {c_lr}; "
                     f"Time cost = {time.time() - global_start}"
@@ -560,6 +631,11 @@ class Simulator:
             # listeners stay installed for the life of the process).
             rec.event("run_end", rounds_completed=len(round_times))
             rec.flush()
+            if prev_sigterm is not None:
+                try:
+                    signal.signal(signal.SIGTERM, prev_sigterm)
+                except (ValueError, OSError):
+                    pass
         return round_times
 
     def _model_spec(self, model, loss, compute_dtype=None) -> ModelSpec:

@@ -1,0 +1,74 @@
+"""Run supervision: heartbeat watchdog, hang-killing process groups, and
+degrade-and-resume relaunch policies.
+
+Two stdlib-only modules (importable before jax, from any subprocess):
+
+- :mod:`blades_tpu.supervision.heartbeat` — the per-round liveness file a
+  supervised workload touches at every telemetry flush (no extra I/O
+  cadence) and the supervisor reads for staleness;
+- :mod:`blades_tpu.supervision.supervisor` — :class:`Supervisor` /
+  :func:`supervise`: launch any workload (Simulator runs, ``bench.py``,
+  the dryrun gates) in its own process group, kill the *whole group* on
+  heartbeat staleness or deadline (SIGTERM -> SIGCONT -> SIGKILL via
+  ``killpg``), and relaunch with ``BLADES_RESUME=1`` under a bounded
+  backoff budget, optionally applying :class:`DegradePolicy` env ladders
+  (mesh -> 1 device, Pallas -> plain XLA, accelerator -> CPU).
+
+CLI: ``python -m blades_tpu.supervision [opts] -- python bench.py``.
+
+Usage, guarantees, and the chaos suite that exercises them:
+``docs/robustness.md``. Telemetry record schema (``supervisor`` /
+``heartbeat``): ``docs/observability.md``.
+
+Reference counterpart: none — the reference delegates process lifetime to
+an assumed-healthy Ray cluster (``src/blades/simulator.py:189-211``).
+"""
+
+# heartbeat is imported eagerly (pure stdlib, and the hot-path import for
+# supervised workloads); the supervisor half resolves lazily so that a
+# workload importing only `beat` pays zero extra import latency — the
+# first beat must land inside the supervisor's startup grace window even
+# on a host where importing the full stack takes seconds.
+from blades_tpu.supervision.heartbeat import (  # noqa: F401
+    HEARTBEAT_ENV,
+    RESUME_ENV,
+    SUPERVISED_ENV,
+    beat,
+    heartbeat_path,
+)
+
+_LAZY = {
+    name: ("blades_tpu.supervision.supervisor", name)
+    for name in (
+        "POLICIES",
+        "AttemptRecord",
+        "DegradePolicy",
+        "SupervisedResult",
+        "Supervisor",
+        "kill_process_group",
+        "list_group",
+        "resolve_policy",
+        "supervise",
+        "main",
+    )
+}
+
+__all__ = [
+    "HEARTBEAT_ENV",
+    "RESUME_ENV",
+    "SUPERVISED_ENV",
+    "beat",
+    "heartbeat_path",
+    *sorted(_LAZY),
+]
+
+
+def __getattr__(name):  # PEP 562
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(
+        f"module 'blades_tpu.supervision' has no attribute {name!r}"
+    )

@@ -1,0 +1,15 @@
+"""``python -m blades_tpu.supervision [opts] -- workload argv...``
+
+See :func:`blades_tpu.supervision.supervisor.main` and
+``docs/robustness.md`` ("Run supervision").
+
+Reference counterpart: none — the reference has no process-lifetime
+tooling at all (Ray owns its workers, ``src/blades/simulator.py:189-211``).
+"""
+
+import sys
+
+from blades_tpu.supervision.supervisor import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+"""Per-round heartbeat file: the liveness signal a supervised run emits.
+
+The hang modes this box exhibits — TPU backend init that blocks forever,
+the 8-device CPU mesh deadlocking in XLA's collective rendezvous — are
+invisible from inside the hung process: no exception fires, no log line is
+written, the process just stops making progress. The only reliable detector
+is an *external* watcher reading a progress signal the workload can emit
+cheaply. That signal is this heartbeat file: one tiny atomic-enough write
+per round, piggybacked on the telemetry flush-once-per-round discipline
+(``blades_tpu/telemetry``) so a supervised run performs no extra I/O
+cadence beyond what it already does.
+
+Protocol:
+
+- the supervisor (``blades_tpu.supervision.supervisor``) exports
+  :data:`HEARTBEAT_ENV` pointing at a file path before launching the
+  workload;
+- the workload calls :func:`beat` at every round flush (``Simulator.run``
+  and ``bench.py``'s child loop do); when the env var is unset this is a
+  dict lookup and an early return — unsupervised runs pay nothing;
+- the supervisor reads staleness with :func:`age_s` (file mtime), killing
+  the workload's whole process group once the age crosses its threshold.
+
+The file body is a single JSON ``heartbeat`` record (schema in
+``docs/observability.md``) so a post-mortem can see *where* the run was,
+not just *when* it last moved: ``{"t": "heartbeat", "ts": ..., "pid": ...,
+"round": N}``.
+
+Stdlib-only (like the telemetry recorder): importable before jax and from
+any subprocess. Reference counterpart: none — the reference assumes a
+permanently healthy Ray cluster (``src/blades/simulator.py:189-211``);
+production FL servers treat per-round watchdogs as first-class
+(Bonawitz et al., 2019, *Towards Federated Learning at Scale*).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+#: Env var the supervisor sets to the heartbeat file path; the workload's
+#: :func:`beat` calls are no-ops when it is unset.
+HEARTBEAT_ENV = "BLADES_HEARTBEAT_FILE"
+
+#: Env var the supervisor sets to "1" so workloads can opt into
+#: supervised-only behavior (e.g. Simulator's SIGTERM -> checkpoint hook).
+SUPERVISED_ENV = "BLADES_SUPERVISED"
+
+#: Env var the supervisor sets to "1" on relaunch attempts; Simulator.run
+#: treats it as ``resume=True`` so a relaunched run continues from the
+#: crash autosave / latest checkpoint instead of restarting from scratch.
+RESUME_ENV = "BLADES_RESUME"
+
+
+def heartbeat_path() -> Optional[str]:
+    """The heartbeat file path for this process (None when unsupervised)."""
+    return os.environ.get(HEARTBEAT_ENV) or None
+
+
+def beat(round_idx: Optional[int] = None, path: Optional[str] = None) -> None:
+    """Touch the heartbeat file (one small write; mtime is the signal).
+
+    No-op when neither ``path`` nor :data:`HEARTBEAT_ENV` is set. Never
+    raises: a full disk or deleted directory must not take down the run the
+    heartbeat observes — a stale heartbeat then (correctly) reports the
+    environment as unhealthy.
+    """
+    path = path or heartbeat_path()
+    if not path:
+        return
+    rec = {"t": "heartbeat", "ts": time.time(), "pid": os.getpid()}
+    if round_idx is not None:
+        rec["round"] = int(round_idx)
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
+def read(path: str) -> Optional[dict]:
+    """The last-written heartbeat record, or None (missing/torn file)."""
+    try:
+        with open(path) as fh:
+            return json.loads(fh.read())
+    except (OSError, ValueError):
+        return None
+
+
+def age_s(path: str, now: Optional[float] = None) -> Optional[float]:
+    """Seconds since the heartbeat file was last touched (None: no beat yet).
+
+    Reads the file *mtime*, not the body — a torn write still moves the
+    mtime, so a workload killed mid-beat never reads as freshly alive.
+    """
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        return None
+    return (time.time() if now is None else now) - mtime

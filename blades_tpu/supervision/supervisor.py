@@ -1,0 +1,579 @@
+"""Run supervisor: heartbeat watchdog + hang-killing process groups +
+degrade-and-resume relaunch policies.
+
+Every documented hang mode of this environment — TPU backend init blocking
+forever on a dead tunnel, the 8-device virtual CPU mesh deadlocking in
+XLA's collective rendezvous, a timed-out capture orphaning grandchildren
+that squat on the single-chip lease — shares one property: the wedged
+process never exits and never raises, so in-process recovery (``try``/
+``except``, ``utils/retry.py``) cannot see it. The supervisor turns each of
+them into a bounded-time, self-recovering event:
+
+1. **Own process group.** The workload launches with
+   ``start_new_session=True``, so it and every grandchild it spawns share a
+   process group the supervisor can kill *atomically* — no orphan can
+   survive holding a pipe or the chip lease.
+2. **Heartbeat watchdog.** The workload touches a heartbeat file once per
+   round (``supervision.heartbeat``, piggybacked on the telemetry
+   flush-once-per-round discipline). Staleness beyond
+   ``heartbeat_timeout_s`` — or ``startup_grace_s`` with no first beat, or
+   a ``deadline_s`` wall clock — triggers the kill.
+3. **Escalated group kill.** SIGTERM (a supervised ``Simulator.run``
+   converts it to an exception, so the crash autosave fires), then SIGCONT
+   (a SIGSTOP'd-but-healthy child may still honor the TERM), then after
+   ``term_grace_s`` SIGKILL — all via ``os.killpg``. The group is verified
+   dead by a ``/proc`` scan before the next attempt launches.
+4. **Degrade and resume.** Each relaunch runs under ``BLADES_RESUME=1``
+   (``Simulator.run`` resumes bit-exactly from the crash autosave /
+   latest checkpoint, PR 2) and may apply a :class:`DegradePolicy` — e.g.
+   collapse the device mesh to a single device (safe: sharded-vs-unsharded
+   equality is a tested invariant, ``tests/test_engine.py``) or disable
+   the Pallas kernel path. The retry budget is bounded with the same
+   exponential backoff as ``utils/retry.py`` (shared
+   :func:`~blades_tpu.utils.retry.backoff_delay`).
+
+Every attempt/kill/degrade/resume event lands in the telemetry trace as a
+``supervisor`` record (schema in ``docs/observability.md``) so a
+post-mortem reads the full recovery trail next to the run's own spans.
+
+Stdlib-only: importable before jax and from host harnesses
+(``scripts/tpu_capture.py`` reuses :func:`kill_process_group`).
+
+Reference counterpart: none — the reference delegates process lifetime to
+Ray and retries nothing (``src/blades/simulator.py:189-211``). The design
+follows the per-round watchdog / pace-steering architecture of production
+FL servers (Bonawitz et al., 2019).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from blades_tpu.supervision import heartbeat as hb
+from blades_tpu.telemetry import Recorder
+
+
+# -- degradation policies -----------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradePolicy:
+    """A named set of env overrides a relaunch applies to shed risk.
+
+    Policies are *cumulative*: attempt ``n`` runs under the union of the
+    first ``n - 1`` configured policies (later dicts win on key conflict),
+    so the workload degrades monotonically instead of oscillating.
+    """
+
+    name: str
+    env: Dict[str, str]
+    note: str = ""
+
+
+#: Built-in policies, orderable into a degradation ladder. ``single_device``
+#: collapses the virtual CPU mesh to one device — it sets the
+#: ``xla_force_host_platform_device_count`` flag that
+#: ``utils/platform.force_virtual_cpu`` refuses to duplicate, so workloads
+#: using the standard recipe inherit the degraded count. ``no_pallas``
+#: falls back from the Mosaic/Pallas kernels to plain-XLA extraction
+#: (``ops/pallas_trimmed.py``). ``cpu_only`` abandons the accelerator
+#: attachment entirely (the tunnel-dead endgame).
+POLICIES: Dict[str, DegradePolicy] = {
+    p.name: p
+    for p in (
+        DegradePolicy(
+            "single_device",
+            {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            },
+            "collapse the device mesh to 1 virtual CPU device "
+            "(sharded == unsharded is a tested invariant)",
+        ),
+        DegradePolicy(
+            "no_pallas",
+            {"BLADES_TPU_NO_PALLAS": "1"},
+            "disable Mosaic/Pallas kernels (plain-XLA extraction path)",
+        ),
+        DegradePolicy(
+            "cpu_only",
+            {
+                "JAX_PLATFORMS": "cpu",
+                "BENCH_FORCE_CPU": "1",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            },
+            "abandon the accelerator attachment for this attempt",
+        ),
+    )
+}
+
+
+def resolve_policy(p: Union[str, DegradePolicy, Dict[str, str]]) -> DegradePolicy:
+    """A policy spec (registry name, policy object, or raw env dict)."""
+    if isinstance(p, DegradePolicy):
+        return p
+    if isinstance(p, str):
+        try:
+            return POLICIES[p]
+        except KeyError:
+            raise ValueError(
+                f"unknown degrade policy {p!r} (built-ins: {sorted(POLICIES)})"
+            ) from None
+    return DegradePolicy("custom", {k: str(v) for k, v in dict(p).items()})
+
+
+# -- process-group primitives -------------------------------------------------
+
+
+def list_group(pgid: int) -> List[int]:
+    """Live pids in process group ``pgid`` (``/proc`` scan; Linux).
+
+    The supervisor's post-kill verification and the orphan-scan tests both
+    use this: ``os.killpg(pgid, 0)`` alone cannot *enumerate* survivors.
+    Zombies (reaped-pending) are excluded — they hold no resources.
+    """
+    pids = []
+    try:
+        entries = os.listdir("/proc")
+    except OSError:
+        return pids
+    for entry in entries:
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat", "rb") as fh:
+                stat = fh.read().decode("ascii", "replace")
+            # field 2 is "(comm)" which may contain spaces/parens; parse
+            # from the LAST ')' — state is field 3, pgrp field 5
+            rest = stat[stat.rfind(")") + 2:].split()
+            state, pgrp = rest[0], int(rest[2])
+        except (OSError, ValueError, IndexError):
+            continue
+        if pgrp == pgid and state != "Z":
+            pids.append(int(entry))
+    return pids
+
+
+def kill_process_group(
+    proc: subprocess.Popen,
+    term_grace_s: float = 10.0,
+    kill_wait_s: float = 10.0,
+) -> Dict[str, object]:
+    """SIGTERM -> SIGCONT -> (grace) -> SIGKILL the whole group of ``proc``.
+
+    SIGTERM first so a supervised ``Simulator.run`` can fire its crash
+    autosave; SIGCONT immediately after so a SIGSTOP'd child still receives
+    the pending TERM; SIGKILL after ``term_grace_s`` for anything that
+    ignored both (a hung backend init does). Returns a forensics dict:
+    ``{"pgid", "escalated" (bool: SIGKILL was needed), "survivors"
+    (pids still alive after the escalation window — [] on success)}``.
+
+    Never signals the supervisor's own group (a ``preexec``-failed launch
+    can leave ``proc`` sharing our pgid).
+    """
+    try:
+        pgid = os.getpgid(proc.pid)
+    except OSError:
+        pgid = proc.pid
+    info: Dict[str, object] = {"pgid": pgid, "escalated": False, "survivors": []}
+    if pgid == os.getpgid(0):
+        # same group as us: fall back to single-process kill, never killpg
+        proc.kill()
+        proc.wait()
+        return info
+
+    def _signal_group(sig: int) -> None:
+        try:
+            os.killpg(pgid, sig)
+        except ProcessLookupError:
+            pass
+        except PermissionError:
+            pass
+
+    _signal_group(signal.SIGTERM)
+    _signal_group(signal.SIGCONT)
+    deadline = time.monotonic() + term_grace_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None and not list_group(pgid):
+            return info
+        time.sleep(0.05)
+    info["escalated"] = True
+    _signal_group(signal.SIGKILL)
+    try:
+        proc.wait(timeout=kill_wait_s)
+    except subprocess.TimeoutExpired:
+        pass
+    # grandchildren get reparented to init and reaped asynchronously; give
+    # the scan a bounded window before reporting survivors
+    deadline = time.monotonic() + kill_wait_s
+    survivors = list_group(pgid)
+    while survivors and time.monotonic() < deadline:
+        time.sleep(0.05)
+        survivors = list_group(pgid)
+    info["survivors"] = survivors
+    return info
+
+
+# -- the supervisor -----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AttemptRecord:
+    """One launch attempt's outcome (``Supervisor.run`` returns the list)."""
+
+    index: int
+    returncode: Optional[int]  # None when the watchdog killed the attempt
+    reason: str  # "exit" | "deadline" | "heartbeat_stale" | "startup_stale"
+    wall_s: float
+    degrade: Tuple[str, ...] = ()
+    resumed: bool = False
+    survivors: Tuple[int, ...] = ()
+
+
+@dataclasses.dataclass
+class SupervisedResult:
+    ok: bool
+    returncode: Optional[int]
+    attempts: List[AttemptRecord]
+
+
+class Supervisor:
+    """Launch ``cmd`` in its own process group and keep it making progress.
+
+    Parameters
+    ----------
+    cmd : the workload argv (any Simulator run, ``bench.py``, a dryrun
+        gate — anything that either finishes or beats the heartbeat).
+    deadline_s : hard wall-clock limit per attempt (None: no limit).
+    heartbeat_timeout_s : max staleness between beats once the workload has
+        beaten at least once (None: wall-clock supervision only).
+    startup_grace_s : time allowed before the FIRST beat — cold XLA
+        compiles legitimately take minutes on this box, so the pre-beat
+        window needs its own (generous) threshold.
+    attempts : total launch budget (first launch + relaunches).
+    base_delay_s / max_delay_s : the ``utils/retry.py`` bounded-backoff
+        shape applied between attempts.
+    degrade : sequence of policy specs (registry names, policy objects, or
+        env dicts); relaunch ``n`` applies the first ``n - 1`` cumulatively.
+    resume : export ``BLADES_RESUME=1`` on relaunches so ``Simulator.run``
+        continues from the autosave instead of restarting.
+    telemetry_path : JSONL file the ``supervisor`` records are appended to
+        (typically the run's own ``telemetry.jsonl``); None disables.
+    heartbeat_file : path the workload beats (exported via
+        ``BLADES_HEARTBEAT_FILE``); default ``<telemetry dir>/heartbeat``
+        or a pid-scoped file under ``/tmp``.
+    stdout / stderr : passed to ``Popen`` — default ``None`` INHERITS the
+        supervisor's streams, preserving workload contracts like
+        ``bench.py``'s one-JSON-line stdout.
+    """
+
+    def __init__(
+        self,
+        cmd: Sequence[str],
+        *,
+        deadline_s: Optional[float] = None,
+        heartbeat_timeout_s: Optional[float] = None,
+        startup_grace_s: float = 900.0,
+        attempts: int = 3,
+        base_delay_s: float = 1.0,
+        max_delay_s: float = 60.0,
+        degrade: Sequence[Union[str, DegradePolicy, Dict[str, str]]] = (),
+        resume: bool = True,
+        telemetry_path: Optional[str] = None,
+        heartbeat_file: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        cwd: Optional[str] = None,
+        poll_s: float = 0.2,
+        term_grace_s: float = 10.0,
+        stdout=None,
+        stderr=None,
+        sleep=time.sleep,
+    ):
+        if attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {attempts}")
+        self.cmd = [str(c) for c in cmd]
+        self.deadline_s = deadline_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.startup_grace_s = startup_grace_s
+        self.attempts = attempts
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.degrade = [resolve_policy(p) for p in degrade]
+        self.resume = resume
+        self.poll_s = poll_s
+        self.term_grace_s = term_grace_s
+        self.env = dict(env or {})
+        self.cwd = cwd
+        self.stdout = stdout
+        self.stderr = stderr
+        self._sleep = sleep
+        if heartbeat_file is None:
+            base = (
+                os.path.dirname(telemetry_path)
+                if telemetry_path
+                else f"/tmp/blades_supervisor_{os.getpid()}"
+            )
+            heartbeat_file = os.path.join(base or ".", "heartbeat")
+        self.heartbeat_file = heartbeat_file
+        self._rec = Recorder(
+            path=telemetry_path,
+            enabled=telemetry_path is not None,
+            meta={"run": "supervisor", "cmd": self.cmd},
+        )
+
+    # -- events ---------------------------------------------------------------
+
+    def _event(self, event: str, **fields) -> None:
+        self._rec.event("supervisor", event=event, **fields)
+        self._rec.flush()
+
+    # -- one attempt ----------------------------------------------------------
+
+    def _attempt_env(self, attempt: int) -> Tuple[Dict[str, str], List[str]]:
+        env = dict(os.environ)
+        env.update(self.env)
+        env[hb.SUPERVISED_ENV] = "1"
+        env[hb.HEARTBEAT_ENV] = self.heartbeat_file
+        applied: List[str] = []
+        for policy in self.degrade[: attempt - 1]:
+            env.update(policy.env)
+            applied.append(policy.name)
+        if attempt > 1 and self.resume:
+            env[hb.RESUME_ENV] = "1"
+        return env, applied
+
+    def _watch(self, proc: subprocess.Popen) -> Tuple[str, Optional[int]]:
+        """Poll until exit or a watchdog trip; returns (reason, returncode)."""
+        t0 = time.monotonic()
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                return "exit", rc
+            now = time.monotonic()
+            if self.deadline_s is not None and now - t0 > self.deadline_s:
+                return "deadline", None
+            if self.heartbeat_timeout_s is not None:
+                age = hb.age_s(self.heartbeat_file)
+                if age is None:
+                    if now - t0 > self.startup_grace_s:
+                        return "startup_stale", None
+                elif age > self.heartbeat_timeout_s:
+                    return "heartbeat_stale", None
+            self._sleep(self.poll_s)
+
+    # -- run ------------------------------------------------------------------
+
+    def run(self) -> SupervisedResult:
+        # late import: utils.retry's package chain pulls jax; the
+        # supervisor itself must stay cheap/stdlib to import (workload
+        # subprocesses and host harnesses import this module pre-jax)
+        from blades_tpu.utils.retry import backoff_delay
+
+        records: List[AttemptRecord] = []
+        last_proc_rc: Optional[int] = None
+        for attempt in range(1, self.attempts + 1):
+            env, applied = self._attempt_env(attempt)
+            resumed = attempt > 1 and self.resume
+            # a beat left over from the previous attempt must not read as
+            # fresh liveness for this one
+            try:
+                os.unlink(self.heartbeat_file)
+            except OSError:
+                pass
+            if applied:
+                self._event(
+                    "degrade", attempt=attempt, policies=applied,
+                    env={k: v for p in self.degrade[: attempt - 1]
+                         for k, v in p.env.items()},
+                )
+            self._event(
+                "launch", attempt=attempt, cmd=self.cmd,
+                degrade=applied, resume=resumed,
+                heartbeat_file=self.heartbeat_file,
+            )
+            t0 = time.monotonic()
+            try:
+                proc = subprocess.Popen(
+                    self.cmd, env=env, cwd=self.cwd, start_new_session=True,
+                    stdout=self.stdout, stderr=self.stderr,
+                )
+            except OSError as e:
+                # unlaunchable argv (missing binary, bad cwd, EPERM): not a
+                # transient failure a retry or degrade policy can heal —
+                # terminate the trail cleanly instead of crashing with the
+                # recorder open and no give_up record
+                self._event(
+                    "launch_failed", attempt=attempt,
+                    error=f"{type(e).__name__}: {e}"[:300],
+                )
+                records.append(AttemptRecord(
+                    index=attempt, returncode=None, reason="launch_failed",
+                    wall_s=time.monotonic() - t0, degrade=tuple(applied),
+                    resumed=resumed,
+                ))
+                self._event("give_up", attempts=attempt)
+                self._rec.close()
+                return SupervisedResult(False, 127, records)
+            reason, rc = self._watch(proc)
+            if reason != "exit":
+                # close the trip/exit race: a child that finished in the
+                # poll gap (e.g. the watchdog tripped on the final round's
+                # long eval compile) must be recorded as its real exit, not
+                # killed-and-relaunched — a completed run already deleted
+                # its autosave, so a bogus relaunch would redo everything
+                rc = proc.poll()
+                if rc is not None:
+                    reason = "exit"
+            survivors: Tuple[int, ...] = ()
+            if reason != "exit":
+                last = hb.read(self.heartbeat_file) or {}
+                info = kill_process_group(proc, term_grace_s=self.term_grace_s)
+                survivors = tuple(info["survivors"])  # type: ignore[arg-type]
+                self._event(
+                    "kill", attempt=attempt, reason=reason,
+                    pgid=info["pgid"], escalated=info["escalated"],
+                    survivors=list(survivors),
+                    heartbeat_age_s=hb.age_s(self.heartbeat_file),
+                    last_round=last.get("round"),
+                )
+                rc = proc.returncode
+            last_proc_rc = rc
+            wall = time.monotonic() - t0
+            rec = AttemptRecord(
+                index=attempt,
+                returncode=rc if reason == "exit" else None,
+                reason=reason, wall_s=wall, degrade=tuple(applied),
+                resumed=resumed, survivors=survivors,
+            )
+            records.append(rec)
+            self._event(
+                "exit", attempt=attempt, reason=reason, returncode=rc,
+                wall_s=round(wall, 3),
+            )
+            if reason == "exit" and rc == 0:
+                self._event("complete", attempts=attempt)
+                self._rec.close()
+                return SupervisedResult(True, 0, records)
+            if attempt < self.attempts:
+                delay = backoff_delay(
+                    attempt, self.base_delay_s, self.max_delay_s
+                )
+                self._event(
+                    "retry", attempt=attempt, delay_s=delay,
+                    resume=self.resume,
+                )
+                self._sleep(delay)
+        self._event("give_up", attempts=self.attempts)
+        self._rec.close()
+        # the raw process returncode of the final attempt (negative signal
+        # number when the watchdog killed it — -15 if the child honored the
+        # graceful SIGTERM, -9 only when SIGKILL escalation was needed), so
+        # callers scripting on the CLI exit code see the real signal
+        return SupervisedResult(False, last_proc_rc, records)
+
+
+def supervise(cmd: Sequence[str], **kwargs) -> SupervisedResult:
+    """One-call form: ``supervise(["python", "bench.py"], deadline_s=3600)``."""
+    return Supervisor(cmd, **kwargs).run()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: ``python -m blades_tpu.supervision [opts] -- cmd args...``.
+
+    The workload's stdout/stderr are inherited (contracts like bench.py's
+    one-JSON-line stdout survive); supervisor diagnostics go to stderr.
+    Exit code: the workload's final rc, or ``128 + signal`` when the last
+    attempt was watchdog-killed.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="blades_tpu.supervision",
+        description="heartbeat-watchdog run supervisor (docs/robustness.md)",
+    )
+    parser.add_argument("--deadline", type=float, default=None,
+                        help="per-attempt wall-clock limit (s)")
+    parser.add_argument("--heartbeat-timeout", type=float, default=None,
+                        help="max staleness between round beats (s)")
+    parser.add_argument("--startup-grace", type=float, default=900.0,
+                        help="time allowed before the first beat (s)")
+    parser.add_argument("--attempts", type=int, default=3)
+    parser.add_argument("--base-delay", type=float, default=1.0)
+    parser.add_argument("--max-delay", type=float, default=60.0)
+    parser.add_argument("--term-grace", type=float, default=10.0)
+    parser.add_argument("--poll", type=float, default=0.2)
+    parser.add_argument("--degrade", action="append", default=[],
+                        metavar="POLICY",
+                        help=f"degradation ladder entry (built-ins: "
+                             f"{sorted(POLICIES)}); repeatable, applied "
+                             "cumulatively from the first relaunch on")
+    parser.add_argument("--no-resume", action="store_true",
+                        help="do not export BLADES_RESUME=1 on relaunches")
+    parser.add_argument("--heartbeat-file", default=None)
+    parser.add_argument("--telemetry", default=None,
+                        help="JSONL file for supervisor records (e.g. the "
+                             "run's telemetry.jsonl)")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="-- workload argv")
+    args = parser.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no workload command given (use: ... -- python bench.py)")
+    for name in args.degrade:
+        if name not in POLICIES:
+            parser.error(
+                f"unknown --degrade policy {name!r} "
+                f"(built-ins: {sorted(POLICIES)})"
+            )
+    if args.deadline is None and args.heartbeat_timeout is None:
+        # without either, _watch never trips: the supervisor degrades to a
+        # plain runner and a hung child waits forever — say so up front
+        print(
+            "[supervisor] warning: neither --deadline nor "
+            "--heartbeat-timeout is set; hangs will NOT be detected "
+            "(exit-code supervision and retries only)",
+            file=sys.stderr,
+        )
+
+    result = supervise(
+        cmd,
+        deadline_s=args.deadline,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        startup_grace_s=args.startup_grace,
+        attempts=args.attempts,
+        base_delay_s=args.base_delay,
+        max_delay_s=args.max_delay,
+        term_grace_s=args.term_grace,
+        poll_s=args.poll,
+        degrade=args.degrade,
+        resume=not args.no_resume,
+        heartbeat_file=args.heartbeat_file,
+        telemetry_path=args.telemetry,
+    )
+    for a in result.attempts:
+        print(
+            f"[supervisor] attempt {a.index}: {a.reason}"
+            f" rc={a.returncode} wall={a.wall_s:.1f}s"
+            + (f" degrade={list(a.degrade)}" if a.degrade else "")
+            + (" resumed" if a.resumed else ""),
+            file=sys.stderr,
+        )
+    if result.ok:
+        return 0
+    rc = result.returncode
+    if rc is None:
+        return 128 + signal.SIGKILL
+    if rc == 0:
+        # final attempt was watchdog-killed but the child trapped SIGTERM
+        # and exited 0: the supervision still GAVE UP — never report
+        # success for a run the trail records as give_up
+        return 1
+    return rc if rc > 0 else 128 - rc

@@ -94,7 +94,16 @@ def restore_state(path: str, like: Any) -> Any:
             f"state:\n  saved:   {saved_treedef}\n  current: {treedef}"
         )
     try:
-        flat = [jnp.asarray(z[f"leaf_{i}"]) for i in range(len(flat_like))]
+        # copy=True is load-bearing: on the CPU backend ``jnp.asarray`` can
+        # ZERO-COPY alias the npz-loaded numpy buffer (alignment-dependent,
+        # jaxlib-build-dependent), and the round program DONATES its state
+        # input — XLA then reuses what it believes is its own buffer as
+        # output memory while numpy frees the real owner, so a resumed
+        # round reads heap garbage (observed: flaky NaN/1e38 params after
+        # resume). Same rule as RoundEngine.init's private params copy.
+        flat = [
+            jnp.array(z[f"leaf_{i}"], copy=True) for i in range(len(flat_like))
+        ]
     except (KeyError, ValueError):
         raise
     except Exception as e:  # noqa: BLE001 - zlib/zipfile on a torn member

@@ -15,6 +15,18 @@ import shutil
 
 _RUN_LOGGERS = ("stats", "debug")
 
+# Crash-recovery artifacts that MUST survive the reference-parity log-dir
+# wipe: a killed run is restarted by constructing a fresh Simulator on the
+# SAME log_path, and ``resume=True`` then needs the previous attempt's
+# ``autosave.npz`` / checkpoint archives (``utils/checkpoint.py``), the
+# telemetry trace (the post-mortem trail, appended across attempts), and
+# the supervisor's heartbeat file (``blades_tpu/supervision``). Wiping
+# them at construction silently degraded every resume-after-kill into a
+# from-scratch rerun — undetectable with a deterministic seed, which is
+# exactly how it went unnoticed.
+_PRESERVE_SUFFIXES = (".npz",)
+_PRESERVE_NAMES = ("telemetry.jsonl", "heartbeat")
+
 
 def initialize_logger(log_root: str) -> None:
     """(Re)create ``log_root`` and attach fresh ``stats``/``debug`` loggers.
@@ -25,6 +37,12 @@ def initialize_logger(log_root: str) -> None:
     (``src/blades/utils.py:67-73``) nukes every logger in the process
     (including jax's and absl's) and leaks the previous run's file handles.
     File format is unchanged: one bare ``%(message)s`` per line.
+
+    The wipe is recovery-aware: the reference clears the whole dir
+    (``src/blades/utils.py:75-79``); here checkpoint archives (``*.npz``),
+    the telemetry trace, and the heartbeat file survive so a kill →
+    relaunch → ``resume=True`` cycle on the same ``log_path`` actually
+    resumes instead of silently restarting (``docs/robustness.md``).
     """
     # teardown first (handlers hold the files open), then wipe the dir
     for name in _RUN_LOGGERS:
@@ -37,8 +55,15 @@ def initialize_logger(log_root: str) -> None:
         # basicConfig) would otherwise echo records in its own format
         logger.propagate = False
     if os.path.exists(log_root):
-        shutil.rmtree(log_root)
-    os.makedirs(log_root)
+        for entry in os.listdir(log_root):
+            if entry.endswith(_PRESERVE_SUFFIXES) or entry in _PRESERVE_NAMES:
+                continue
+            path = os.path.join(log_root, entry)
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.unlink(path)
+    os.makedirs(log_root, exist_ok=True)
     for name in _RUN_LOGGERS:
         fh = logging.FileHandler(os.path.join(log_root, name))
         fh.setLevel(logging.INFO)

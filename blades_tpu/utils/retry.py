@@ -23,6 +23,19 @@ from blades_tpu.telemetry import get_recorder
 T = TypeVar("T")
 
 
+def backoff_delay(
+    attempt: int, base_delay: float = 1.0, max_delay: float = 60.0
+) -> float:
+    """Bounded-exponential delay before retry ``attempt`` (1-based):
+    ``min(base_delay * 2**(attempt-1), max_delay)``.
+
+    The single source of the backoff shape, shared by :func:`retry_call`
+    (in-process host-side retries) and the run supervisor's relaunch
+    budget (``blades_tpu.supervision.supervisor`` — process-level
+    retries), so both layers degrade on the same curve."""
+    return min(base_delay * 2.0 ** (attempt - 1), max_delay)
+
+
 def retry_call(
     fn: Callable[[], T],
     *,
@@ -52,7 +65,7 @@ def retry_call(
         except retry_on as e:
             if attempt == attempts:
                 raise
-            delay = min(base_delay * 2.0 ** (attempt - 1), max_delay)
+            delay = backoff_delay(attempt, base_delay, max_delay)
             rec = get_recorder()
             rec.counter(f"retry.{describe}")
             rec.event(

@@ -61,6 +61,7 @@ GALLERY = [
     ("telemetry_trace.py", ["--rounds", "2", "--out", "@TMP@"], {}, 600),
     ("fault_injection.py",
      ["--rounds", "2", "--out", "@TMP@", "--aggs", "median"], {}, 900),
+    ("supervised_run.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
     ("fedavg_ipm.py",
      ["--rounds", "2", "--steps", "2", "--out", "@TMP@"], {}, 900),
     ("robustness_matrix.py",
@@ -92,6 +93,8 @@ API_MODULES = [
     "blades_tpu.parallel.distributed",
     "blades_tpu.utils.checkpoint",
     "blades_tpu.utils.retry",
+    "blades_tpu.supervision.supervisor",
+    "blades_tpu.supervision.heartbeat",
     "blades_tpu.leaf",
     "blades_tpu.leaf.preprocess",
 ]

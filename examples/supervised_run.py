@@ -1,0 +1,151 @@
+"""Run supervision: a hung run is heartbeat-killed and resumed bit-exactly.
+
+This box's documented failure modes (a TPU tunnel that hangs backend init
+forever, an XLA collective deadlock) never raise — the process just stops.
+The run supervisor (``blades_tpu/supervision``, docs/robustness.md) turns
+that into a bounded-time, self-recovering event, demonstrated end to end:
+
+1. a reference run completes uninterrupted → final parameters saved;
+2. the same run is launched **supervised** with a saboteur that hangs it
+   hard at round 2 (after spawning a grandchild, like a real orphaned
+   probe). The Simulator beats a heartbeat file at every round flush; the
+   supervisor sees the beat go stale, kills the child's **entire process
+   group** (SIGTERM → the crash autosave fires → SIGKILL; zero orphans),
+   and relaunches with ``BLADES_RESUME=1``;
+3. the relaunch resumes from the autosave and finishes — final parameters
+   **bit-identical** to the uninterrupted run, with the attempt/kill/
+   resume trail in the run's own ``telemetry.jsonl``.
+
+Usage: ``python examples/supervised_run.py [--rounds 3] [--out DIR]``
+(``--child`` is the internal supervised-workload mode).
+
+Reference counterpart: none — the reference assumes a permanently healthy
+Ray cluster (``src/blades/simulator.py:189-211``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def child_main(args) -> None:
+    """The supervised workload: a small MLP federation with per-round
+    checkpoints, hanging hard at ``--hang-at`` exactly once."""
+    from blades_tpu.utils.platform import force_virtual_cpu
+
+    force_virtual_cpu(1)
+
+    import numpy as np
+
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import Synthetic
+    from blades_tpu.ops.pytree import ravel
+
+    sentinel = os.path.normpath(args.out) + ".hang_fired"
+    # fresh launch (not a supervised resume): clear a previous
+    # invocation's sentinel or the rerun demo would never hang
+    if os.environ.get("BLADES_RESUME") != "1" and os.path.exists(sentinel):
+        os.unlink(sentinel)
+
+    def saboteur(rnd, state, m):
+        if args.hang_at and rnd == args.hang_at and not os.path.exists(sentinel):
+            open(sentinel, "w").close()
+            subprocess.Popen(["sleep", "600"])  # the orphan-to-be
+            print(f"[child] hanging hard at round {rnd}", flush=True)
+            time.sleep(600)
+
+    sim = Simulator(
+        dataset=Synthetic(num_clients=6, train_size=300, test_size=60,
+                          noise=0.3, cache=False),
+        aggregator="median",
+        log_path=args.out,
+        seed=7,
+    )
+    sim.run(
+        "mlp",
+        global_rounds=args.rounds, local_steps=1, train_batch_size=8,
+        client_lr=0.2, server_lr=1.0, validate_interval=args.rounds,
+        checkpoint_path=os.path.join(args.out, "ck"), checkpoint_interval=1,
+        on_round_end=saboteur,
+    )
+    np.save(args.params_out, np.asarray(ravel(sim.server.state.params)))
+    print("[child] run complete", flush=True)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--out", default=os.path.join(REPO, "results", "supervised_demo"))
+    p.add_argument("--child", action="store_true")
+    p.add_argument("--hang-at", type=int, default=0)
+    p.add_argument("--params-out", default=None)
+    args = p.parse_args()
+    if args.child:
+        child_main(args)
+        return
+
+    import numpy as np
+
+    from blades_tpu.supervision import Supervisor
+
+    hang_round = max(args.rounds - 1, 1)
+
+    def child_cmd(out, params, hang):
+        return [sys.executable, os.path.abspath(__file__), "--child",
+                "--rounds", str(args.rounds), "--out", out,
+                "--params-out", params, "--hang-at", str(hang)]
+
+    # -- 1. uninterrupted reference ----------------------------------------
+    ref_params = os.path.join(args.out, "ref_params.npy")
+    subprocess.run(
+        child_cmd(os.path.join(args.out, "ref"), ref_params, 0),
+        check=True, cwd=REPO,
+    )
+
+    # -- 2. supervised run with a mid-run hard hang ------------------------
+    sup_dir = os.path.join(args.out, "supervised")
+    sup_params = os.path.join(args.out, "sup_params.npy")
+    telemetry = os.path.join(sup_dir, "telemetry.jsonl")
+    if os.path.exists(telemetry):
+        os.unlink(telemetry)  # fresh demo: don't append to a prior trail
+    result = Supervisor(
+        child_cmd(sup_dir, sup_params, hang_round),
+        heartbeat_timeout_s=8.0,     # round beats go stale -> group kill
+        startup_grace_s=600.0,       # jax import + first compile window
+        attempts=2,                  # one relaunch (with BLADES_RESUME=1)
+        term_grace_s=8.0,            # SIGTERM window for the crash autosave
+        telemetry_path=telemetry,
+        cwd=REPO,
+    ).run()
+
+    print("\nattempt trail:")
+    for a in result.attempts:
+        print(f"  attempt {a.index}: {a.reason:16s} "
+              f"degrade={list(a.degrade) or '-'} resumed={a.resumed} "
+              f"orphans={len(a.survivors)}")
+    assert result.ok, "supervised run did not recover"
+    assert result.attempts[0].reason == "heartbeat_stale"
+    assert result.attempts[0].survivors == (), "orphans survived the group kill"
+
+    ref = np.load(ref_params)
+    out = np.load(sup_params)
+    exact = bool(np.array_equal(ref, out))
+    print(f"resumed run final params bit-identical to uninterrupted: {exact}")
+    assert exact
+
+    with open(telemetry) as f:
+        events = [r for r in map(json.loads, f) if r.get("t") == "supervisor"]
+    print("supervisor telemetry trail:",
+          [e["event"] for e in events])
+
+
+if __name__ == "__main__":
+    main()

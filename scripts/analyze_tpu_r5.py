@@ -236,7 +236,10 @@ def trace_section(lines):
     lines.append("\n| category | ms | share |")
     lines.append("|---|---:|---:|")
     for c, d in sorted(by_cat.items(), key=lambda kv: -kv[1]):
-        lines.append(f"| {c} | {d / 1e3:.1f} | {100 * d / busy:.0f}% |")
+        # guard busy == 0 (a trace whose selected events all have dur 0):
+        # a ZeroDivisionError here fails EVERY tpu_watch digest
+        share = f"{100 * d / busy:.0f}%" if busy else ""
+        lines.append(f"| {c} | {d / 1e3:.1f} | {share} |")
     lines.append("\nTop ops by total time:\n")
     lines.append("| op | ms | category |")
     lines.append("|---|---:|---|")

@@ -1,0 +1,350 @@
+"""Seeded chaos suite: randomized-but-reproducible fault weather crossed
+with the aggregator registry, asserting the robustness invariants
+end-to-end (docs/robustness.md).
+
+Each scenario is a pure function of its integer seed (``make_scenario``):
+an aggregator drawn round-robin from the registry pool (so a sweep of N >=
+len(pool) seeds covers every defense) crossed with randomized fault-model
+weather (dropout / participation schedules / stragglers / NaN-Inf-bitflip
+corruption), optionally under a Byzantine attack. The invariants checked
+per scenario (``check_invariants``):
+
+1. the run completes with **finite final parameters and eval loss** — a
+   zero-participant round is an *explicit skip* (zero pseudo-gradient),
+   never a NaN step;
+2. the telemetry trace carries one ``faults`` record per round, and the
+   non-finite guard's exclusion counts are consistent with the corruption
+   mode (every delivered NaN/Inf row excluded, bit-flip rows at most);
+3. **masked-row inertness, end to end** — re-running the scenario with the
+   corrupted rows' *content* swapped (NaN <-> Inf) yields bit-identical
+   final parameters: excluded payload content cannot leak into the model;
+4. (supervised scenarios, ``--child`` mode) a SIGKILL or hard hang at a
+   random round, followed by the run supervisor's group-kill + relaunch
+   with ``BLADES_RESUME=1``, resumes **bit-exactly** against the
+   uninterrupted run.
+
+Usage::
+
+    python scripts/chaos.py --sweep 24            # full sweep, one JSON line
+    python scripts/chaos.py --child --seed 3 --out DIR \
+        [--kill-at R | --hang-at R] [--params-out F]   # one supervised child
+
+``tests/test_chaos.py`` runs a reduced slice tier-1 and the full sweep
+under the ``slow`` marker. Reference counterpart: none — the reference has
+no fault surface and no test suite at all (SURVEY.md section 4); the
+invariant style follows Karimireddy et al., 2021 (*Learning from History*):
+robustness claims only hold when every round completes with state intact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# aggregator pool: the full registry minus byzantinesgd (its default
+# thresholds filter everything on tiny synthetic runs — covered by
+# tests/test_simulator.py with explicit thresholds) and the async family's
+# duplicate (asynccenteredclipping shares asyncmean's masking semantics)
+AGG_POOL = (
+    "mean", "median", "trimmedmean", "krum", "multikrum", "geomed",
+    "autogm", "centeredclipping", "clustering", "clippedclustering",
+    "fltrust", "dnc", "signguard", "asyncmean",
+)
+ATTACK_POOL = (None, "signflipping", "ipm", "alie")
+NUM_CLIENTS = 8
+ROUNDS = 3
+
+
+def make_scenario(seed: int) -> dict:
+    """Deterministic scenario from an integer seed (JSON-serializable, so
+    the supervised ``--child`` mode reconstructs it exactly)."""
+    import numpy as np
+
+    rng = np.random.default_rng(1000 + seed)
+    agg = AGG_POOL[seed % len(AGG_POOL)]  # round-robin: sweeps cover all
+    agg_kws = (
+        {"num_byzantine": 2}
+        if agg in ("trimmedmean", "krum", "multikrum", "dnc")
+        else {}
+    )
+
+    attack = ATTACK_POOL[int(rng.integers(len(ATTACK_POOL)))]
+    num_byz = int(rng.integers(1, 3)) if attack else 0
+
+    fault: dict = {}
+    participation = rng.random()
+    if participation < 0.5:
+        fault["dropout_rate"] = float(rng.choice([0.2, 0.3, 0.5]))
+    elif participation < 0.7:
+        period = int(rng.integers(2, 4))
+        sched = rng.random((period, NUM_CLIENTS)) < 0.7
+        sched[0, 0] = True  # at least one guaranteed participant slot
+        fault["participation_schedule"] = sched.tolist()
+    if rng.random() < 0.4:
+        fault["straggler_rate"] = float(rng.choice([0.2, 0.4]))
+        fault["max_staleness"] = int(rng.integers(1, 4))
+    corruption = rng.random()
+    if corruption < 0.45:
+        n_bad = int(rng.integers(1, 3))
+        fault["corrupt_clients"] = [int(c) for c in rng.choice(
+            NUM_CLIENTS, size=n_bad, replace=False)]
+        fault["corrupt_mode"] = str(rng.choice(["nan", "inf", "bitflip"]))
+    elif corruption < 0.65:
+        fault["corrupt_rate"] = 0.2
+        fault["corrupt_mode"] = str(rng.choice(["nan", "inf"]))
+    if not fault:
+        fault["dropout_rate"] = 0.3  # every scenario carries some weather
+
+    return {
+        "seed": seed,
+        "agg": agg,
+        "agg_kws": agg_kws,
+        "attack": attack,
+        "num_byz": num_byz,
+        "fault": fault,
+        "rounds": ROUNDS,
+        "sim_seed": int(rng.integers(10_000)),
+    }
+
+
+def inertness_variant(scn: dict) -> dict | None:
+    """The NaN <-> Inf content-swap twin of ``scn`` (None when the scenario
+    has no whole-row corruption to swap). Both corruption modes poison the
+    same rows under the same RNG draws and both are fully excluded by the
+    non-finite guard, so final parameters must be **bit-identical** — the
+    end-to-end form of the masked-row inertness contract
+    (``tests/test_faults.py`` pins the unit-level form per aggregator)."""
+    mode = scn["fault"].get("corrupt_mode")
+    if mode not in ("nan", "inf"):
+        return None
+    twin = json.loads(json.dumps(scn))  # deep copy
+    twin["fault"]["corrupt_mode"] = "inf" if mode == "nan" else "nan"
+    return twin
+
+
+def build_sim(scn: dict, log_path: str):
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import Synthetic
+
+    sim = Simulator(
+        dataset=Synthetic(
+            num_clients=NUM_CLIENTS, train_size=400, test_size=80,
+            noise=0.3, cache=False,
+        ),
+        aggregator=scn["agg"],
+        aggregator_kws=scn["agg_kws"],
+        attack=scn["attack"],
+        num_byzantine=scn["num_byz"],
+        log_path=log_path,
+        seed=scn["sim_seed"],
+    )
+    if scn["agg"] == "fltrust":
+        # trust the last client: honest (byzantine ids are the prefix) and
+        # outside the common corrupt_clients draws; if the weather drops it
+        # anyway the round degrades to an explicit skip (tested neutral)
+        sim.set_trusted_clients([sim.get_clients()[-1]._id])
+    return sim
+
+
+def run_scenario(
+    scn: dict,
+    log_path: str,
+    on_round_end=None,
+    checkpoint: bool = False,
+    resume: bool = False,
+):
+    """Execute one scenario; returns ``(sim, flat_final_params)``."""
+    import numpy as np
+
+    from blades_tpu.ops.pytree import ravel
+
+    sim = build_sim(scn, log_path)
+    kw = dict(
+        global_rounds=scn["rounds"], local_steps=1, train_batch_size=8,
+        client_lr=0.2, server_lr=1.0, validate_interval=scn["rounds"],
+        fault_model=dict(scn["fault"]),
+        on_round_end=on_round_end,
+        resume=resume,
+    )
+    if checkpoint:
+        kw.update(
+            checkpoint_path=os.path.join(log_path, "ck"),
+            checkpoint_interval=1,
+        )
+    sim.run("mlp", **kw)
+    return sim, np.asarray(ravel(sim.server.state.params))
+
+
+def check_invariants(scn: dict, log_path: str, params) -> list:
+    """Invariants 1-2 for a completed scenario; returns violation strings."""
+    import numpy as np
+
+    violations = []
+    if not np.isfinite(params).all():
+        violations.append("non-finite final parameters")
+    trace = os.path.join(log_path, "telemetry.jsonl")
+    recs = []
+    if os.path.exists(trace):
+        with open(trace) as f:
+            for line in f:
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    pass
+    faults = [r for r in recs if r.get("t") == "faults"]
+    if len(faults) != scn["rounds"]:
+        violations.append(
+            f"expected {scn['rounds']} faults records, got {len(faults)}"
+        )
+    mode = scn["fault"].get("corrupt_mode")
+    for r in faults:
+        if r["participants"] > NUM_CLIENTS:
+            violations.append(f"participants {r['participants']} > K")
+        if mode in ("nan", "inf"):
+            # every delivered whole-row-poisoned payload must be excluded
+            if r["excluded_nonfinite"] != r["corrupted"]:
+                violations.append(
+                    f"round {r['round']}: corrupted={r['corrupted']} but "
+                    f"excluded_nonfinite={r['excluded_nonfinite']}"
+                )
+        elif r["excluded_nonfinite"] > r["corrupted"]:
+            violations.append(
+                f"round {r['round']}: excluded {r['excluded_nonfinite']} "
+                f"> corrupted {r['corrupted']} (honest rows went non-finite)"
+            )
+    rounds_done = [r for r in recs if r.get("t") == "round"]
+    for r in rounds_done:
+        if not np.isfinite(r.get("train_loss", 0.0)):
+            # a skip round keeps the previous params; the loss metric is
+            # computed from real (pre-fault) training and must stay finite
+            violations.append(f"round {r['round']}: non-finite train_loss")
+    return violations
+
+
+# -- sweep (the evidence artifact) --------------------------------------------
+
+
+def sweep(n: int, out_dir: str) -> dict:
+    """Run scenarios 0..n-1 (+ inertness twins) in-process; returns the
+    summary dict (also printed as one JSON line by ``main``)."""
+    import numpy as np
+
+    results, violations = [], []
+    for seed in range(n):
+        scn = make_scenario(seed)
+        log = os.path.join(out_dir, f"s{seed:03d}")
+        sim, params = run_scenario(scn, log)
+        v = check_invariants(scn, log, params)
+        ev = sim.evaluate(scn["rounds"], 64)
+        if not np.isfinite(ev["Loss"]):
+            v.append("non-finite eval loss")
+        twin = inertness_variant(scn)
+        if twin is not None:
+            _, params2 = run_scenario(twin, os.path.join(out_dir, f"s{seed:03d}_twin"))
+            if not np.array_equal(params, params2):
+                v.append("nan<->inf content swap changed final params")
+        results.append({
+            "seed": seed, "agg": scn["agg"], "attack": scn["attack"],
+            "fault": {k: ("schedule" if k == "participation_schedule" else val)
+                      for k, val in scn["fault"].items()},
+            "loss": round(float(ev["Loss"]), 4),
+            "twin_checked": twin is not None,
+            "violations": v,
+        })
+        violations.extend(f"seed {seed}: {msg}" for msg in v)
+    return {
+        "metric": "chaos_scenarios",
+        "scenarios": n,
+        "aggregators_covered": sorted({r["agg"] for r in results}),
+        "inertness_pairs": sum(r["twin_checked"] for r in results),
+        "violations": violations,
+        "ok": not violations,
+        "results": results,
+    }
+
+
+# -- supervised child ---------------------------------------------------------
+
+
+def child_main(args) -> None:
+    """One scenario as a supervised workload: beats the heartbeat each
+    round (via ``Simulator.run``), checkpoints every round, honors
+    ``BLADES_RESUME=1``, and can SIGKILL itself or hang hard at a given
+    round — exactly once, gated by a sentinel file, so the supervisor's
+    relaunch completes."""
+    import signal as _signal
+    import subprocess
+    import time
+
+    from blades_tpu.utils.platform import force_virtual_cpu
+
+    force_virtual_cpu(int(os.environ.get("CHAOS_DEVICES", "1")))
+
+    import numpy as np
+
+    scn = make_scenario(args.seed)
+    # sentinel lives NEXT TO the log dir, not inside it: the relaunched
+    # Simulator wipes non-recovery files from its log_path at construction.
+    # A FRESH launch (not a supervised resume) clears any stale sentinel
+    # from a previous invocation with the same --out, or the saboteur
+    # would never fire again and the scenario would silently weaken.
+    sentinel = os.path.normpath(args.out) + ".fault_fired"
+    if os.environ.get("BLADES_RESUME") != "1" and os.path.exists(sentinel):
+        os.unlink(sentinel)
+
+    def saboteur(rnd, state, m):
+        if os.path.exists(sentinel):
+            return
+        if args.kill_at is not None and rnd == args.kill_at:
+            open(sentinel, "w").close()
+            os.kill(os.getpid(), _signal.SIGKILL)  # no autosave, no cleanup
+        if args.hang_at is not None and rnd == args.hang_at:
+            open(sentinel, "w").close()
+            # a grandchild the group kill must also reap, then a hard hang:
+            # the heartbeat goes stale and the supervisor reaps the GROUP
+            subprocess.Popen(["sleep", "600"])
+            time.sleep(600)
+
+    _, params = run_scenario(
+        scn, args.out, on_round_end=saboteur, checkpoint=True,
+    )
+    if args.params_out:
+        np.save(args.params_out, params)
+    print("CHAOS_RESULT " + json.dumps({
+        "seed": args.seed, "agg": scn["agg"],
+        "finite": bool(np.isfinite(params).all()),
+    }), flush=True)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--sweep", type=int, default=None, metavar="N",
+                   help="run scenarios 0..N-1 in-process; one JSON line out")
+    p.add_argument("--out", default=os.path.join(REPO, "results", "chaos"))
+    p.add_argument("--child", action="store_true",
+                   help="run ONE scenario as a supervised workload")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kill-at", type=int, default=None)
+    p.add_argument("--hang-at", type=int, default=None)
+    p.add_argument("--params-out", default=None)
+    args = p.parse_args()
+
+    if args.child:
+        child_main(args)
+        return 0
+    n = args.sweep if args.sweep is not None else 24
+    from blades_tpu.utils.platform import apply_env_platform
+
+    apply_env_platform()
+    summary = sweep(n, args.out)
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,7 +28,8 @@ OUT = os.path.join(REPO, "results", "tpu_r5")
 ROWS = os.path.join(OUT, "rows.jsonl")
 
 sys.path.insert(0, REPO)
-from blades_tpu.utils.retry import retry_call  # noqa: E402  (stdlib-only import chain)
+from blades_tpu.supervision.supervisor import kill_process_group  # noqa: E402  (stdlib-only)
+from blades_tpu.utils.retry import retry_call  # noqa: E402
 
 
 def log(msg):
@@ -39,24 +40,34 @@ def run(cmd, timeout, env=None):
     full_env = dict(os.environ)
     if env:
         full_env.update({k: str(v) for k, v in env.items()})
+    # own session/process group: the headline bench.py is itself a
+    # subprocess ladder, so a plain timeout-kill would orphan its
+    # grandchild (possibly hung forever in backend init), which keeps the
+    # inherited pipes open — communicate() then blocks with no timeout,
+    # wedging the capture while the orphan squats on the single-chip lease
+    p = subprocess.Popen(
+        cmd, cwd=REPO, env=full_env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True,
+    )
     try:
-        p = subprocess.run(
-            cmd, cwd=REPO, env=full_env, capture_output=True, text=True,
-            timeout=timeout,
-        )
-        return p.returncode, p.stdout, p.stderr
-    except subprocess.TimeoutExpired as e:
-        # keep whatever the child printed before the timeout: the OOM-marker
-        # scan and error records must see a RESOURCE_EXHAUSTED dump even when
-        # the child then hung to the deadline
-        def _txt(b):
-            if isinstance(b, bytes):
-                return b.decode("utf-8", "replace")
-            return b or ""
+        out, err = p.communicate(timeout=timeout)
+        return p.returncode, out, err
+    except subprocess.TimeoutExpired:
+        # kill the ENTIRE group (SIGTERM -> SIGCONT -> SIGKILL escalation,
+        # blades_tpu/supervision) so no grandchild survives, THEN collect
+        # whatever reached the pipes before the deadline: the OOM-marker
+        # scan and error records must see a RESOURCE_EXHAUSTED dump even
+        # when the child then hung to the deadline
+        kill_process_group(p, term_grace_s=5.0)
+        try:
+            out, err = p.communicate(timeout=30)
+        except (subprocess.TimeoutExpired, ValueError):
+            out, err = "", ""
         return (
             None,
-            _txt(e.stdout),
-            _txt(e.stderr) + f"\ntimeout after {timeout}s",
+            out or "",
+            (err or "") + f"\ntimeout after {timeout}s",
         )
 
 
@@ -285,9 +296,19 @@ def _stages_done():
 
 def _on_tpu(h):
     """The single 'headline measured on the accelerator' predicate (used by
-    both the persistence decision and the resume/completeness checks)."""
-    return h.get("value") is not None and h.get("platform") not in (
-        None, "cpu"
+    both the persistence decision and the resume/completeness checks).
+
+    A ``config``-tagged payload is a reduced-K / non-default ladder settle
+    (bench.py labels every fallback): it must NOT settle the full-K
+    headline — persisting it would stop all retries (warm-cache retries
+    are the whole point of the attempt budget) and leave the lever table
+    without its 1.00x baseline. Such a settle is kept as a clearly-labeled
+    interim artifact (``headline_interim.json``) and counted as a failed
+    attempt instead."""
+    return (
+        h.get("value") is not None
+        and h.get("platform") not in (None, "cpu")
+        and not h.get("config")
     )
 
 
@@ -323,22 +344,43 @@ def main():
         except Exception:
             headline = {"error": (err or out)[-300:]}
         headline["date"] = datetime.datetime.now(datetime.timezone.utc).isoformat()
-        # a failed/off-TPU headline is never persisted as the result; the
-        # failure is appended to HEAD_FAILS and retried at the next window
-        # (the watcher re-fires within ~3 min while the tunnel is up) until
-        # MAX_ATTEMPTS, after which _headline_done treats it as settled. If
-        # the tunnel is ALSO dead now, bail; otherwise keep going so
-        # sections 2-4 still collect evidence in this window.
+        # a failed/off-TPU/config-tagged headline is never persisted as the
+        # result; the failure is appended to HEAD_FAILS and retried at the
+        # next window (the watcher re-fires within ~3 min while the tunnel
+        # is up) until MAX_ATTEMPTS, after which _headline_done treats it
+        # as settled. If the tunnel is ALSO dead now, bail; otherwise keep
+        # going so sections 2-4 still collect evidence in this window.
         if not _on_tpu(headline):
-            log(f"headline failed/off-TPU, not persisted: {headline}")
-            if not tunnel_alive():
+            log(f"headline failed/off-TPU/reduced, not persisted: {headline}")
+            if headline.get("config") and headline.get("value") is not None:
+                # the ladder settled on a reduced/non-default config (e.g.
+                # the K=100 smoke after a full-K timeout): keep it as a
+                # clearly-labeled interim artifact — never headline.json /
+                # bench_tpu.json, which _headline_done would treat as the
+                # settled full-K evidence and stop retrying. It ALWAYS
+                # counts toward the give-up cap, and is recorded BEFORE the
+                # tunnel probe below: the full-K attempt already burned its
+                # ~40 min ladder regardless of whether the tunnel died
+                # afterwards — uncapped, every later window would re-burn
+                # that ladder forever.
+                with open(os.path.join(OUT, "headline_interim.json"), "w") as f:
+                    json.dump(dict(headline, interim=True), f, indent=1)
+                with open(HEAD_FAILS, "a") as f:
+                    f.write(json.dumps(headline) + "\n")
+                log(f"reduced settle kept as headline_interim.json "
+                    f"({headline['config']}); full-K headline still pending "
+                    f"(attempt {_headline_attempts()}/{MAX_ATTEMPTS})")
+                if not tunnel_alive():
+                    log("tunnel now dead — bailing (settle recorded)")
+                    sys.exit(2)
+            elif not tunnel_alive():
                 # the tunnel died under the bench: transient by
                 # construction, so it must NOT consume one of the
                 # MAX_ATTEMPTS (a run of sub-minute windows would otherwise
                 # permanently abandon the headline)
                 log("tunnel died under the headline — bailing unrecorded")
                 sys.exit(2)
-            if _transient(str(headline.get("error", ""))):
+            elif _transient(str(headline.get("error", ""))):
                 # tunnel-flap signature with the tunnel back up: retry at
                 # the next window without consuming an attempt
                 log("transient headline failure — will retry, not counted")
@@ -489,8 +531,11 @@ def main():
         abandoned.append("stages")
     if abandoned:
         log(f"capture complete with ABANDONED artifacts (gave up after "
-            f"{MAX_ATTEMPTS} attempts; delete the attempt files under "
-            f"{OUT} to retry): {abandoned}")
+            f"{MAX_ATTEMPTS} attempts): {abandoned}. To force a retry: "
+            f"for headline/stages delete the *_attempts.jsonl file under "
+            f"{OUT}; for capped rows prune that row's failed attempts from "
+            f"rows.jsonl (the give-up state lives THERE, not in any "
+            f"attempts file)")
     else:
         log("capture complete")
 

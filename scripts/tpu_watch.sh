@@ -22,8 +22,15 @@ while [ $((SECONDS - START)) -lt "$BUDGET" ]; do
   # and the accepted platform list); its inner subprocess timeout is 90 s.
   if timeout -k 10 110 python scripts/tpu_capture.py --probe 2>/dev/null; then
     echo "TPU ALIVE at $(date -u), capturing..."
-    TUNNEL_PROBED=1 python scripts/tpu_capture.py >> results/tpu_r5/capture.log 2>&1
+    # timeout -k backstop: the capture now killpg's its own timed-out
+    # children (blades_tpu/supervision), but if the capture process itself
+    # ever wedges (e.g. a future bug re-blocks communicate()) this bounds
+    # the window instead of eating the whole watch budget; SIGKILL
+    # escalation because a hung backend init ignores SIGTERM
+    TUNNEL_PROBED=1 timeout -k 60 "${CAPTURE_TIMEOUT_S:-28800}" \
+      python scripts/tpu_capture.py >> results/tpu_r5/capture.log 2>&1
     rc=$?
+    [ $rc -ge 124 ] && echo "capture HIT THE timeout -k BACKSTOP (rc=$rc) at $(date -u)"
     # secure whatever this window produced: regenerate the digest and
     # commit the evidence files (never the churning logs) so a late-round
     # window still lands in git even if no one is at the keyboard
@@ -35,8 +42,15 @@ while [ $((SECONDS - START)) -lt "$BUDGET" ]; do
     # and anything else staged in the shared index (an agent's
     # half-finished work) must not ride along
     evid=()
+    # the *_attempts.jsonl files carry the give-up state that gates
+    # _headline_done/_stages_done — they must be secured in git with the
+    # evidence or a fresh checkout retries what was already abandoned;
+    # headline_interim.json is the clearly-labeled reduced-K settle
     for f in results/tpu_r5/headline.json results/tpu_r5/rows.jsonl \
              results/tpu_r5/stages.json results/tpu_r5/analysis.md \
+             results/tpu_r5/headline_attempts.jsonl \
+             results/tpu_r5/stages_attempts.jsonl \
+             results/tpu_r5/headline_interim.json \
              results/tpu_r5/profile results/bench_tpu.json; do
       [ -e "$f" ] && evid+=("$f")
     done

@@ -50,6 +50,8 @@ def summarize(records: List[dict]) -> dict:
     rounds = []
     compiles = []
     defenses = []
+    supervisor: Dict[str, int] = {}
+    kill_reasons = []
     meta = {}
     for r in records:
         t = r.get("t")
@@ -68,8 +70,16 @@ def summarize(records: List[dict]) -> dict:
             compiles.append(r["dur_s"])
         elif t == "defense":
             defenses.append(r)
+        elif t == "supervisor":
+            ev = r.get("event", "?")
+            supervisor[ev] = supervisor.get(ev, 0) + 1
+            if ev == "kill":
+                kill_reasons.append(r.get("reason"))
         elif t == "meta":
-            meta.update(r)
+            # a supervised trace interleaves supervisor + run meta records;
+            # keep the RUN's config (the supervisor's carries only cmd)
+            if r.get("run") != "supervisor":
+                meta.update(r)
     for s in spans.values():
         s["mean_s"] = s["total_s"] / s["count"]
 
@@ -103,6 +113,7 @@ def summarize(records: List[dict]) -> dict:
             "max_s": max(compiles) if compiles else 0.0,
         },
         "defense": defense_summary,
+        "supervisor": {"events": supervisor, "kill_reasons": kill_reasons},
     }
 
 
@@ -155,6 +166,12 @@ def format_table(summary: dict) -> str:
             f"{k}={v:.3f}" for k, v in sorted(summary["defense"].items())
         )
         lines.append(f"defense: {pairs}")
+    sup = summary.get("supervisor") or {}
+    if sup.get("events"):
+        pairs = ", ".join(f"{k}={v}" for k, v in sorted(sup["events"].items()))
+        lines.append(f"supervisor: {pairs}")
+        if sup["kill_reasons"]:
+            lines.append(f"  kill reasons: {', '.join(sup['kill_reasons'])}")
     return "\n".join(lines)
 
 

@@ -23,3 +23,11 @@ jax.config.update("jax_enable_x64", False)
 
 def pytest_report_header(config):
     return f"jax {jax.__version__}, devices: {jax.device_count()} ({jax.devices()[0].platform})"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy end-to-end scenarios (full chaos sweep, supervised "
+        "subprocess runs) excluded from tier-1 via -m 'not slow'",
+    )

@@ -12,6 +12,8 @@ harness; its perf story is qualitative, README.rst:37-42).
 import importlib.util
 import json
 import os
+import subprocess
+import time
 
 import pytest
 
@@ -237,3 +239,92 @@ def test_ladder_does_not_descend_on_cpu_number(cap):
     assert not cap.measured({"rounds_per_sec": 5.0, "platform": "cpu"})
     assert cap.measured({"rounds_per_sec": 5.0, "platform": "tpu"})
     assert cap.measured({"rounds_per_sec": 5.0, "platform": "axon"})
+
+
+def test_config_tagged_settle_never_settles_headline(cap, tmp_path):
+    """ADVICE medium #2: a reduced-K ladder settle (bench tags it with
+    `config`) must not persist as headline.json/bench_tpu.json — it is
+    kept as a labeled interim artifact, counted as a failed attempt, and
+    the full-K headline stays pending for later windows."""
+    # the predicate itself rejects config-tagged payloads
+    assert not cap._on_tpu(
+        {"value": 2.0, "platform": "tpu", "config": "tpu_k100"}
+    )
+    smoke_settle = json.dumps(
+        {"value": 2.0, "platform": "tpu", "config": "tpu_k100",
+         "attempt_errors": "full: timeout after 2400s"}
+    )
+
+    def reduced_headline(cmd, timeout, env=None):
+        if "-c" in cmd:
+            return 0, "ALIVE tpu", ""
+        if cmd[-1].endswith("bench.py") and (env or {}).get(
+            "BENCH_CHILD"
+        ) != 1:
+            return 0, smoke_settle, ""
+        if cmd[-1].endswith("stage_timing.py"):
+            return 0, 'STAGES {"sampler_s": 1.0, "platform": "tpu"}', ""
+        return 0, GOOD_CHILD, ""
+
+    cap.run = reduced_headline
+    assert run_main(cap) == 2  # headline still pending
+    assert not os.path.exists(tmp_path / "headline.json")
+    assert not os.path.exists(tmp_path / "results" / "bench_tpu.json")
+    interim = json.load(open(tmp_path / "headline_interim.json"))
+    assert interim["interim"] is True and interim["config"] == "tpu_k100"
+    # counted toward the give-up cap (a transient-marker attempt_errors
+    # string must not exempt it: its full-K attempt already timed out)
+    assert cap._headline_attempts() == 1
+    assert not cap._headline_done()
+
+
+def test_config_tagged_settle_counted_even_when_tunnel_dies(cap, tmp_path):
+    """The reduced-K settle's full-K attempt already burned its ladder:
+    it must consume an attempt BEFORE the tunnel post-probe, or a flap
+    right after the settle would let every later window re-burn the
+    ~40-min ladder forever."""
+    state = {"probes": 0}
+
+    def settle_then_tunnel_dies(cmd, timeout, env=None):
+        if "-c" in cmd:
+            state["probes"] += 1  # pre-flight alive, dead after the settle
+            return (0, "ALIVE tpu", "") if state["probes"] == 1 else (1, "", "")
+        return 0, json.dumps(
+            {"value": 2.0, "platform": "tpu", "config": "tpu_k100"}
+        ), ""
+
+    cap.run = settle_then_tunnel_dies
+    assert run_main(cap) == 2  # bailed for the watcher
+    assert cap._headline_attempts() == 1  # ...but the attempt is recorded
+    assert os.path.exists(tmp_path / "headline_interim.json")
+
+
+def test_config_tagged_headline_json_not_done(cap, tmp_path):
+    """A config-tagged headline.json from an older capture must read as
+    NOT settled, so later windows retry the full-K headline."""
+    with open(tmp_path / "headline.json", "w") as f:
+        json.dump({"value": 2.0, "platform": "tpu", "config": "tpu_k100"}, f)
+    assert not cap._headline_done()
+
+
+def test_run_kills_whole_process_group_on_timeout(cap):
+    """ADVICE medium #1: a timed-out child's grandchild (inheriting the
+    stdout pipe, like an orphaned bench subprocess hung in backend init)
+    must not wedge communicate() nor survive holding the chip lease —
+    run() kills the entire process group and still returns the partial
+    output."""
+    marker = "600.125"  # unique sleep arg to scan for survivors
+    t0 = time.monotonic()
+    rc, out, err = cap.run(
+        ["/bin/sh", "-c",
+         f"echo PARTIAL; sleep {marker} & trap '' TERM; sleep 600"],
+        timeout=1,
+    )
+    assert time.monotonic() - t0 < 25.0  # no indefinite communicate() wedge
+    assert rc is None
+    assert "timeout after 1" in err
+    assert "PARTIAL" in out  # pre-timeout output still collected
+    time.sleep(0.3)
+    scan = subprocess.run(["pgrep", "-f", f"sleep {marker}"],
+                          capture_output=True)
+    assert scan.returncode != 0, "grandchild survived the group kill"

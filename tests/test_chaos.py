@@ -1,0 +1,173 @@
+"""Seeded chaos suite (``scripts/chaos.py``): randomized-but-reproducible
+fault weather crossed with the aggregator registry, asserting the PR-2
+robustness invariants end to end — finite loss or explicit skip,
+masked-row inertness (NaN <-> Inf content swaps cannot move the model),
+and SIGKILL-at-a-random-round + supervised resume being bit-exact.
+
+Tier-1 runs a reduced slice (two scenarios + one inertness twin); the full
+>= 20-scenario sweep and the subprocess supervised scenarios carry the
+``slow`` marker (tier-1 excludes them via ``-m 'not slow'``). The full
+sweep's committed evidence lives in ``results/chaos_sweep.json``.
+
+Reference counterpart: none — the reference has no fault surface and no
+tests (SURVEY.md section 4).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHAOS = os.path.join(REPO, "scripts", "chaos.py")
+
+spec = importlib.util.spec_from_file_location("chaos_under_test", CHAOS)
+chaos = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(chaos)
+
+# the tier-1 slice: one clean-dropout scenario and one whole-row-NaN
+# scenario (whose inertness twin is also exercised); the other 22+ run in
+# the slow sweep
+TIER1_SEEDS = (1, 3)
+
+
+def test_scenarios_deterministic_and_serializable():
+    for seed in range(24):
+        a, b = chaos.make_scenario(seed), chaos.make_scenario(seed)
+        assert a == b
+        json.dumps(a)  # child mode rebuilds scenarios from the seed alone
+
+
+def test_sweep_covers_every_pool_aggregator():
+    aggs = {chaos.make_scenario(s)["agg"] for s in range(24)}
+    assert aggs == set(chaos.AGG_POOL)
+    assert len(chaos.AGG_POOL) + 6 <= 24  # >= 20 scenarios, registry covered
+
+
+def test_inertness_twin_only_for_whole_row_corruption():
+    for seed in range(24):
+        scn = chaos.make_scenario(seed)
+        twin = chaos.inertness_variant(scn)
+        mode = scn["fault"].get("corrupt_mode")
+        if mode in ("nan", "inf"):
+            assert twin is not None
+            assert twin["fault"]["corrupt_mode"] != mode
+            unchanged = {k: v for k, v in twin.items() if k != "fault"}
+            assert unchanged == {k: v for k, v in scn.items() if k != "fault"}
+        else:
+            assert twin is None
+
+
+@pytest.mark.parametrize("seed", TIER1_SEEDS)
+def test_scenario_invariants_tier1(seed, tmp_path):
+    scn = chaos.make_scenario(seed)
+    log = str(tmp_path / f"s{seed}")
+    sim, params = chaos.run_scenario(scn, log)
+    violations = chaos.check_invariants(scn, log, params)
+    assert violations == []
+    ev = sim.evaluate(scn["rounds"], 64)
+    assert np.isfinite(ev["Loss"])
+
+
+def test_inertness_twin_bit_identical_tier1(tmp_path):
+    """End-to-end masked-row inertness: seed 1 corrupts a delivered row
+    with NaN; the twin corrupts the same row (same RNG draws) with Inf.
+    Both are excluded by the non-finite guard, so the final parameters
+    must not differ by a single bit."""
+    scn = chaos.make_scenario(1)
+    assert scn["fault"]["corrupt_mode"] == "nan"  # scenario table pin
+    twin = chaos.inertness_variant(scn)
+    _, p_nan = chaos.run_scenario(scn, str(tmp_path / "nan"))
+    _, p_inf = chaos.run_scenario(twin, str(tmp_path / "inf"))
+    np.testing.assert_array_equal(p_nan, p_inf)
+
+
+# --------------------------------------------------------------- full sweep
+
+
+@pytest.mark.slow
+def test_full_sweep_zero_violations(tmp_path):
+    """>= 20 seeded fault x aggregator scenarios, zero invariant
+    violations (the committed evidence run: results/chaos_sweep.json)."""
+    summary = chaos.sweep(24, str(tmp_path))
+    assert summary["scenarios"] == 24
+    assert set(summary["aggregators_covered"]) == set(chaos.AGG_POOL)
+    assert summary["inertness_pairs"] >= 8
+    assert summary["violations"] == []
+
+
+@pytest.mark.slow
+def test_supervised_sigkill_resume_bit_exact(tmp_path):
+    """A chaos child SIGKILLs itself (no autosave, no cleanup — the
+    hardest crash) at round 2; the supervisor relaunches with
+    BLADES_RESUME=1 and the resumed run's final params match the
+    uninterrupted run bit-for-bit (per-round atomic checkpoints)."""
+    from blades_tpu.supervision import Supervisor
+
+    env = dict(os.environ, CHAOS_DEVICES="1")
+    ref_params = tmp_path / "ref.npy"
+    p = subprocess.run(
+        [sys.executable, CHAOS, "--child", "--seed", "1",
+         "--out", str(tmp_path / "ref"), "--params-out", str(ref_params)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=420,
+    )
+    assert p.returncode == 0, (p.stdout, p.stderr)
+
+    sup_params = tmp_path / "sup.npy"
+    telem = str(tmp_path / "sup" / "telemetry.jsonl")
+    result = Supervisor(
+        [sys.executable, CHAOS, "--child", "--seed", "1",
+         "--out", str(tmp_path / "sup"), "--params-out", str(sup_params),
+         "--kill-at", "2"],
+        attempts=2, base_delay_s=0.1, poll_s=0.2, telemetry_path=telem,
+        heartbeat_file=str(tmp_path / "hb"), env={"CHAOS_DEVICES": "1"},
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    ).run()
+    assert result.ok
+    assert result.attempts[0].reason == "exit"
+    assert result.attempts[0].returncode == -9  # SIGKILL'd itself
+    assert result.attempts[1].resumed
+    np.testing.assert_array_equal(np.load(ref_params), np.load(sup_params))
+
+
+@pytest.mark.slow
+def test_bench_one_json_line_under_supervisor(tmp_path):
+    """bench.py's one-JSON-line contract holds under the supervisor: the
+    inherited stdout carries exactly the payload line (CPU fallback here —
+    clearly labeled by bench itself)."""
+    env = dict(os.environ)
+    env.update({
+        "BENCH_PROBE_TIMEOUT": "120", "BENCH_SMOKE_TIMEOUT": "420",
+        "JAX_PLATFORMS": "cpu",
+    })
+    p = subprocess.run(
+        [sys.executable, "-m", "blades_tpu.supervision", "--attempts", "1",
+         "--deadline", "900", "--", sys.executable, "bench.py"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900,
+    )
+    lines = [l for l in p.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, p.stdout
+    payload = json.loads(lines[0])
+    assert payload["metric"].endswith("rounds_per_sec")
+
+
+@pytest.mark.slow
+def test_graft_entry_gate_under_supervisor():
+    """The driver's single-chip compile gate still passes when wrapped in
+    the supervisor (deadline-only supervision; heartbeats are optional)."""
+    code = (
+        "import __graft_entry__ as g, jax; fn, args = g.entry(); "
+        "out = jax.jit(fn)(*args); jax.block_until_ready(out); print('GATE_OK')"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "blades_tpu.supervision", "--attempts", "1",
+         "--deadline", "600", "--", sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=700,
+    )
+    assert p.returncode == 0, (p.stdout, p.stderr)
+    assert "GATE_OK" in p.stdout

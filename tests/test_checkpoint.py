@@ -55,6 +55,29 @@ def test_truncated_checkpoint_raises_clean_error(tmp_path):
             restore_state(p, like)
 
 
+def test_restored_leaves_are_owned_copies_safe_to_donate(tmp_path):
+    """Regression (found by the supervised-resume e2e): ``jnp.asarray`` on
+    an npz-loaded array can ZERO-COPY alias the numpy buffer on the CPU
+    backend; the round program donates its state input, so XLA reused the
+    alias as output memory while numpy freed the real owner — resumed
+    rounds flakily read heap garbage (NaN/1e38 params). restore_state must
+    return jax-owned copies. This canary donates a restored leaf, thrashes
+    the heap with the same-size allocations, and checks the values held."""
+    import jax.numpy as jnp
+
+    src = {"w": jnp.asarray(
+        np.random.default_rng(0).normal(size=(50_000,)).astype(np.float32)
+    )}
+    p = str(tmp_path / "ck")
+    save_state(p, src)
+    restored = restore_state(p, src)
+    donating = jax.jit(lambda x: x * 1.0, donate_argnums=0)
+    out = donating(restored["w"])
+    for _ in range(16):  # heap churn over any freed aliased pages
+        np.full(50_000, np.nan, np.float32)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(src["w"]))
+
+
 def test_simulator_resume_bit_exact(tmp_path):
     def make():
         ds = Synthetic(num_clients=4, train_size=200, test_size=40, cache=False)

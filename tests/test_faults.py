@@ -432,8 +432,39 @@ def test_mid_run_kill_resumes_bit_exactly_under_faults(tmp_path):
     recs = [json.loads(l) for l in open(trace)]
     assert any(r.get("t") == "crash_checkpoint" for r in recs)
 
+    # a FRESH (resume=False) run on the same log dir must invalidate the
+    # stale autosave UP FRONT — checked from inside round 1, because the
+    # run-completion cleanup also unlinks it at the end (asserting after
+    # the run would pass vacuously). A supervised relaunch (BLADES_RESUME=1)
+    # of a run that dies pre-autosave must never resume another
+    # experiment's state.
+    seen = {}
+
+    def probe(rnd, state, m):
+        if rnd == 1:
+            seen["autosave_at_round1"] = os.path.exists(autosave)
+
+    d = _sim(tmp_path, "b", "median", seed=5)
+    d.run("mlp", **dict(kw, global_rounds=1), on_round_end=probe)
+    assert seen["autosave_at_round1"] is False, (
+        "fresh run did not invalidate the stale crash autosave before "
+        "its first round"
+    )
+
+    # recreate the crash so the resume path below still has its autosave
+    b2 = _sim(tmp_path, "b", "median", seed=5)
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        b2.run("mlp", **kw, on_round_end=boom)
+
     c = _sim(tmp_path, "b", "median", seed=5)  # same log dir -> same autosave
-    c.run("mlp", **kw, resume=True)
+    assert os.path.exists(autosave), (
+        "constructing the resuming Simulator must not wipe the autosave "
+        "(utils/logging.py preserves *.npz across the log-dir wipe)"
+    )
+    times = c.run("mlp", **kw, resume=True)
+    # ACTUAL resumption: only rounds 3..4 ran (a silent from-scratch rerun
+    # would return 4 wall times and still match params bit-for-bit)
+    assert len(times) == 2
     out = np.asarray(ravel(c.server.state.params))
     np.testing.assert_array_equal(ref, out)
     # the completed resume consumed the crash autosave: a later resume=True

@@ -36,6 +36,28 @@ def test_reinit_same_dir_wipes_and_keeps_writing(tmp_path):
     assert [r["x"] for r in read_stats(root)] == [2]
 
 
+def test_reinit_preserves_crash_recovery_artifacts(tmp_path):
+    """The log-dir wipe must NOT destroy what a kill -> relaunch ->
+    resume=True cycle needs: checkpoint archives (*.npz incl. the crash
+    autosave), the telemetry trace, and the supervisor heartbeat file.
+    Regression: the unconditional rmtree silently degraded every
+    resume-after-kill into a from-scratch rerun (undetectable under a
+    deterministic seed)."""
+    root = str(tmp_path / "out")
+    initialize_logger(root)
+    keep = ["autosave.npz", "ck.npz", "telemetry.jsonl", "heartbeat"]
+    for name in keep + ["stats", "scratch.txt"]:
+        with open(os.path.join(root, name), "w") as f:
+            f.write("x")
+    os.makedirs(os.path.join(root, "profile"))
+    initialize_logger(root)
+    for name in keep:
+        assert os.path.exists(os.path.join(root, name)), name
+    assert not os.path.exists(os.path.join(root, "scratch.txt"))
+    assert not os.path.exists(os.path.join(root, "profile"))
+    assert open(os.path.join(root, "stats")).read() == ""  # fresh handler
+
+
 def test_stats_format_byte_compatible(tmp_path):
     """The on-disk format is the reference's: one bare dict repr per line
     (what ``read_stats``/the MNIST example's ``read_json`` parse)."""

@@ -1,0 +1,406 @@
+"""Run-supervisor tests (``blades_tpu/supervision``): heartbeat watchdog,
+group-kill primitives, degrade-and-resume policies, and the end-to-end
+acceptance scenario — a supervised Simulator hung mid-run is detected via
+heartbeat staleness, its whole process group reaped (zero orphans), and
+the relaunch resumes bit-exactly from the per-round checkpoint, with the
+attempt/kill/resume trail in ``telemetry.jsonl``.
+
+All tier-1: the hung children are ``sleep``-based stubs (no TPU, and no
+jax import in the fast tests); the one real-Simulator scenario runs the
+chaos child (``scripts/chaos.py``) on a single virtual CPU device.
+
+Reference counterpart: none — the reference delegates process lifetime to
+an assumed-healthy Ray cluster (``src/blades/simulator.py:189-211``).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from blades_tpu.supervision import heartbeat as hb
+from blades_tpu.supervision.supervisor import (
+    POLICIES,
+    Supervisor,
+    kill_process_group,
+    list_group,
+    resolve_policy,
+    supervise,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHAOS = os.path.join(REPO, "scripts", "chaos.py")
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _sup_events(path):
+    return [r for r in _records(path) if r.get("t") == "supervisor"]
+
+
+# ------------------------------------------------------------ heartbeat file
+
+
+def test_beat_noop_without_env(tmp_path, monkeypatch):
+    monkeypatch.delenv(hb.HEARTBEAT_ENV, raising=False)
+    hb.beat(round_idx=1)  # must not raise, must not create anything
+    f = tmp_path / "hb"
+    hb.beat(round_idx=3, path=str(f))
+    rec = hb.read(str(f))
+    assert rec["t"] == "heartbeat" and rec["round"] == 3
+    assert hb.age_s(str(f)) < 5.0
+    assert hb.age_s(str(tmp_path / "missing")) is None
+
+
+def test_beat_env_path(tmp_path, monkeypatch):
+    f = tmp_path / "hb"
+    monkeypatch.setenv(hb.HEARTBEAT_ENV, str(f))
+    hb.beat(round_idx=7)
+    assert hb.read(str(f))["round"] == 7
+
+
+def test_beat_never_raises_on_bad_path(monkeypatch):
+    monkeypatch.setenv(hb.HEARTBEAT_ENV, "/proc/definitely/not/writable/hb")
+    hb.beat(round_idx=1)  # swallowed OSError
+
+
+# --------------------------------------------------------- group primitives
+
+
+def test_kill_process_group_reaps_grandchildren():
+    """A SIGTERM-ignoring child that spawned a grandchild: the whole group
+    dies and a pgid scan finds zero survivors (the orphaned-grandchild
+    wedge from ADVICE.md medium #1)."""
+    p = subprocess.Popen(
+        ["/bin/sh", "-c", "trap '' TERM; sleep 600 & sleep 600"],
+        start_new_session=True,
+    )
+    pgid = os.getpgid(p.pid)
+    time.sleep(0.3)  # let the grandchild spawn
+    assert len(list_group(pgid)) >= 2
+    t0 = time.monotonic()
+    info = kill_process_group(p, term_grace_s=0.5)
+    assert time.monotonic() - t0 < 15.0
+    assert info["escalated"] is True  # TERM was trapped; KILL was needed
+    assert info["survivors"] == []
+    assert list_group(pgid) == []
+
+
+def test_kill_process_group_graceful_term():
+    p = subprocess.Popen(["sleep", "600"], start_new_session=True)
+    info = kill_process_group(p, term_grace_s=5.0)
+    assert info["escalated"] is False  # sleep dies on TERM
+    assert info["survivors"] == []
+
+
+def test_sigstopped_child_still_killed():
+    """SIGSTOP'd processes cannot run TERM handlers; the escalation must
+    still remove them (SIGKILL acts on stopped processes)."""
+    p = subprocess.Popen(["sleep", "600"], start_new_session=True)
+    os.kill(p.pid, signal.SIGSTOP)
+    info = kill_process_group(p, term_grace_s=0.3)
+    assert info["survivors"] == []
+    assert p.poll() is not None
+
+
+# ------------------------------------------------------------- the watchdog
+
+
+def test_hung_child_killed_within_staleness_window(tmp_path):
+    """Satellite: a deliberately-hung child (never beats) is killed
+    group-wide within the startup-grace window, grandchild included."""
+    telem = tmp_path / "telemetry.jsonl"
+    sup = Supervisor(
+        ["/bin/sh", "-c", "sleep 600 & sleep 600"],
+        heartbeat_timeout_s=0.5, startup_grace_s=1.0, attempts=1,
+        term_grace_s=0.5, poll_s=0.1, telemetry_path=str(telem),
+        heartbeat_file=str(tmp_path / "hb"),
+    )
+    t0 = time.monotonic()
+    result = sup.run()
+    assert time.monotonic() - t0 < 20.0
+    assert not result.ok
+    (attempt,) = result.attempts
+    assert attempt.reason == "startup_stale"
+    assert attempt.survivors == ()  # zero orphans, asserted via pgid scan
+    kills = [e for e in _sup_events(str(telem)) if e["event"] == "kill"]
+    assert len(kills) == 1 and kills[0]["survivors"] == []
+
+
+def test_stale_after_beats_triggers_heartbeat_kill(tmp_path):
+    """A child that beats, then hangs: the kill reason is heartbeat
+    staleness (not startup), and the last beaten round is recorded."""
+    beat_then_hang = (
+        "import sys, time; sys.path.insert(0, %r); "
+        "from blades_tpu.supervision.heartbeat import beat; "
+        "beat(round_idx=2); time.sleep(600)" % REPO
+    )
+    telem = tmp_path / "telemetry.jsonl"
+    result = supervise(
+        [sys.executable, "-c", beat_then_hang],
+        heartbeat_timeout_s=1.0, startup_grace_s=30.0, attempts=1,
+        term_grace_s=0.5, poll_s=0.1, telemetry_path=str(telem),
+        heartbeat_file=str(tmp_path / "hb"),
+    )
+    (attempt,) = result.attempts
+    assert attempt.reason == "heartbeat_stale"
+    (kill,) = [e for e in _sup_events(str(telem)) if e["event"] == "kill"]
+    assert kill["last_round"] == 2
+
+
+def test_beating_child_survives(tmp_path):
+    code = (
+        "import sys, time; sys.path.insert(0, %r); "
+        "from blades_tpu.supervision.heartbeat import beat\n"
+        "for i in range(5): time.sleep(0.3); beat(round_idx=i)" % REPO
+    )
+    result = supervise(
+        [sys.executable, "-c", code],
+        heartbeat_timeout_s=1.0, startup_grace_s=30.0, attempts=1,
+        poll_s=0.1, heartbeat_file=str(tmp_path / "hb"),
+    )
+    assert result.ok and result.attempts[0].reason == "exit"
+
+
+# ------------------------------------------------- degrade & resume policies
+
+
+def test_degrade_ladder_cumulative_and_resume_env(tmp_path):
+    """Attempt 1 runs clean; attempt 2 adds policy 1; attempt 3 adds policy
+    2 on top — and every relaunch exports BLADES_RESUME=1."""
+    probe = tmp_path / "attempts.jsonl"
+    code = (
+        "import json, os, sys\n"
+        "with open(%r, 'a') as f:\n"
+        "    f.write(json.dumps({k: os.environ.get(k) for k in\n"
+        "        ('JAX_PLATFORMS', 'BLADES_TPU_NO_PALLAS', 'BLADES_RESUME',\n"
+        "         'BLADES_SUPERVISED')}) + '\\n')\n"
+        "sys.exit(1)" % str(probe)
+    )
+    result = supervise(
+        [sys.executable, "-c", code],
+        attempts=3, base_delay_s=0.01, poll_s=0.05,
+        degrade=["single_device", "no_pallas"],
+        heartbeat_file=str(tmp_path / "hb"),
+        telemetry_path=str(tmp_path / "telemetry.jsonl"),
+    )
+    assert not result.ok
+    rows = _records(str(probe))
+    assert len(rows) == 3
+    assert rows[0]["BLADES_SUPERVISED"] == "1"
+    assert rows[0]["BLADES_RESUME"] is None and rows[0]["BLADES_TPU_NO_PALLAS"] is None
+    assert rows[1]["BLADES_RESUME"] == "1"
+    assert rows[1]["JAX_PLATFORMS"] == "cpu"  # single_device applied
+    assert rows[1]["BLADES_TPU_NO_PALLAS"] is None  # ladder, not all-at-once
+    assert rows[2]["BLADES_TPU_NO_PALLAS"] == "1"  # cumulative
+    events = _sup_events(str(tmp_path / "telemetry.jsonl"))
+    assert [e["event"] for e in events if e["event"] in
+            ("degrade", "give_up")].count("degrade") == 2
+    assert result.attempts[2].degrade == ("single_device", "no_pallas")
+
+
+def test_policy_resolution():
+    assert resolve_policy("no_pallas") is POLICIES["no_pallas"]
+    custom = resolve_policy({"FOO": "1"})
+    assert custom.env == {"FOO": "1"}
+    with pytest.raises(ValueError, match="unknown degrade policy"):
+        resolve_policy("warp_speed")
+
+
+def test_backoff_shared_with_retry():
+    from blades_tpu.utils.retry import backoff_delay
+
+    assert [backoff_delay(i, 1.0, 60.0) for i in (1, 2, 3, 7)] == [
+        1.0, 2.0, 4.0, 60.0]
+
+
+def test_success_passthrough_single_json_line(tmp_path):
+    """bench.py's one-JSON-line contract survives supervision: the child's
+    stdout is inherited, supervisor diagnostics go to stderr only."""
+    p = subprocess.run(
+        [sys.executable, "-m", "blades_tpu.supervision", "--attempts", "2",
+         "--deadline", "60", "--", sys.executable, "-c",
+         "print('{\"metric\": \"x\", \"value\": 1.0}')"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert p.returncode == 0, p.stderr
+    assert p.stdout.strip() == '{"metric": "x", "value": 1.0}'
+    assert "[supervisor]" in p.stderr
+
+
+def test_cli_requires_command():
+    p = subprocess.run(
+        [sys.executable, "-m", "blades_tpu.supervision", "--attempts", "1"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert p.returncode == 2
+    assert "no workload command" in p.stderr
+
+
+def test_cli_rejects_unknown_degrade_policy():
+    p = subprocess.run(
+        [sys.executable, "-m", "blades_tpu.supervision",
+         "--degrade", "single-device", "--", "true"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert p.returncode == 2  # argparse usage error, not a raw traceback
+    assert "unknown --degrade policy" in p.stderr
+    assert "Traceback" not in p.stderr
+
+
+def test_unlaunchable_workload_terminates_trail_cleanly(tmp_path):
+    """A bad argv must not crash the supervisor: the trail ends with
+    launch_failed + give_up and the result reports rc 127."""
+    telem = tmp_path / "telemetry.jsonl"
+    result = supervise(
+        ["/definitely/not/a/binary-xyz"], attempts=3,
+        telemetry_path=str(telem), heartbeat_file=str(tmp_path / "hb"),
+    )
+    assert not result.ok and result.returncode == 127
+    (attempt,) = result.attempts  # no retries: unlaunchable is not transient
+    assert attempt.reason == "launch_failed"
+    kinds = [e["event"] for e in _sup_events(str(telem))]
+    assert kinds[-2:] == ["launch_failed", "give_up"]
+
+
+def test_cli_never_exits_zero_on_give_up():
+    """A child trapping SIGTERM to exit 0 must not turn a given-up
+    supervision into CLI success."""
+    p = subprocess.run(
+        [sys.executable, "-m", "blades_tpu.supervision", "--attempts", "1",
+         "--deadline", "0.5", "--poll", "0.1", "--term-grace", "5", "--",
+         "/bin/sh", "-c", "trap 'exit 0' TERM; sleep 600"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert p.returncode == 1, (p.returncode, p.stderr)
+
+
+def test_killed_final_attempt_reports_real_signal(tmp_path):
+    """A child that honors the graceful SIGTERM yields returncode -15, not
+    a blanket SIGKILL report (callers script on 128+signal)."""
+    result = supervise(
+        ["sleep", "600"], deadline_s=0.5, attempts=1, poll_s=0.1,
+        term_grace_s=5.0, heartbeat_file=str(tmp_path / "hb"),
+    )
+    assert not result.ok
+    assert result.returncode == -signal.SIGTERM
+    assert result.attempts[0].reason == "deadline"
+
+
+def test_fresh_unsupervised_run_starts_a_new_trace(tmp_path, monkeypatch):
+    """The log-dir wipe preserves telemetry.jsonl for kill->relaunch
+    post-mortems, but a FRESH unsupervised run on the same log_path is a
+    new experiment: per-run consumers (trace_summary, chaos invariant
+    counts) must not see the previous run's records. Supervised attempt 1
+    must NOT truncate (the supervisor's launch record is already there)."""
+    import json as _json
+
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import Synthetic
+
+    monkeypatch.delenv(hb.SUPERVISED_ENV, raising=False)
+    log = str(tmp_path / "run")
+    kw = dict(global_rounds=1, local_steps=1, train_batch_size=8,
+              validate_interval=1)
+
+    def one_run():
+        Simulator(
+            dataset=Synthetic(num_clients=4, train_size=80, test_size=40,
+                              cache=False),
+            log_path=log, seed=0,
+        ).run("mlp", **kw)
+
+    one_run()
+    one_run()  # fresh rerun: trace restarts
+    recs = [_json.loads(l) for l in open(os.path.join(log, "telemetry.jsonl"))]
+    assert sum(1 for r in recs if r.get("t") == "round") == 1
+    assert sum(1 for r in recs if r.get("t") == "meta") == 1
+
+    monkeypatch.setenv(hb.SUPERVISED_ENV, "1")
+    one_run()  # supervised attempt: appends, never truncates
+    recs = [_json.loads(l) for l in open(os.path.join(log, "telemetry.jsonl"))]
+    assert sum(1 for r in recs if r.get("t") == "round") == 2
+
+
+# ------------------------------------------- end-to-end: hang, kill, resume
+
+
+def test_supervised_simulator_hang_is_killed_and_resumes_bit_exact(tmp_path):
+    """Acceptance: a supervised run whose child hangs hard at round 2
+    (spawning a grandchild first) is detected via heartbeat staleness, the
+    whole process group is reaped (zero orphans), and the relaunch resumes
+    from the per-round checkpoint producing bit-identical final parameters
+    to an uninterrupted run — trail in telemetry.jsonl."""
+    env = dict(os.environ, CHAOS_DEVICES="1")
+    env.pop(hb.HEARTBEAT_ENV, None)
+
+    # uninterrupted reference (same scenario seed, fresh log dir)
+    ref_out = tmp_path / "ref"
+    ref_params = tmp_path / "ref_params.npy"
+    p = subprocess.run(
+        [sys.executable, CHAOS, "--child", "--seed", "0",
+         "--out", str(ref_out), "--params-out", str(ref_params)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=420,
+    )
+    assert p.returncode == 0, (p.stdout, p.stderr)
+    assert "CHAOS_RESULT" in p.stdout
+
+    # supervised run: hangs at round 2, exactly once
+    sup_out = tmp_path / "sup"
+    sup_params = tmp_path / "sup_params.npy"
+    telem = str(sup_out / "telemetry.jsonl")
+    sup = Supervisor(
+        [sys.executable, CHAOS, "--child", "--seed", "0",
+         "--out", str(sup_out), "--params-out", str(sup_params),
+         "--hang-at", "2"],
+        heartbeat_timeout_s=6.0, startup_grace_s=300.0, attempts=2,
+        base_delay_s=0.1, term_grace_s=5.0, poll_s=0.2,
+        telemetry_path=telem, heartbeat_file=str(tmp_path / "hb"),
+        env={"CHAOS_DEVICES": "1"}, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    result = sup.run()
+    assert result.ok, result
+    assert len(result.attempts) == 2
+    first, second = result.attempts
+    assert first.reason == "heartbeat_stale"
+    assert first.survivors == ()  # grandchild `sleep 600` reaped too
+    assert second.reason == "exit" and second.resumed
+
+    # bit-exact resume
+    ref = np.load(ref_params)
+    out = np.load(sup_params)
+    np.testing.assert_array_equal(ref, out)
+
+    # the attempt/kill/resume trail is in the run's own telemetry.jsonl
+    events = _sup_events(telem)
+    kinds = [e["event"] for e in events]
+    for expected in ("launch", "kill", "retry", "launch", "complete"):
+        assert expected in kinds, kinds
+    (kill,) = [e for e in events if e["event"] == "kill"]
+    assert kill["reason"] == "heartbeat_stale"
+    assert kill["survivors"] == []
+    # the hang fires in round 2's on_round_end, BEFORE round 2's flush/beat
+    # — so the last recorded liveness is round 1's beat
+    assert kill["last_round"] == 1
+    launches = [e for e in events if e["event"] == "launch"]
+    assert launches[0]["resume"] is False and launches[1]["resume"] is True
+    # the child's own records interleave in the same trace: attempt 1
+    # flushed round 1, then hung in round 2's on_round_end — round 2's
+    # completed STATE rode the crash autosave (so the resumed attempt
+    # starts at round 3), but its round record was lost to the kill
+    rounds = [r for r in _records(telem) if r.get("t") == "round"]
+    assert {r["round"] for r in rounds} == {1, 3}
+    # SIGTERM reached the hung-in-Python child first: the crash autosave
+    # trail shows the graceful half of the escalation fired
+    crash = [r for r in _records(telem) if r.get("t") == "crash_checkpoint"]
+    assert crash and crash[0]["round"] == 2
+    assert "SupervisorTermination" in crash[0]["error"]
