@@ -62,6 +62,7 @@ _LAZY = {
     "ClientOptSpec": ("blades_tpu.core", "ClientOptSpec"),
     "ServerOptSpec": ("blades_tpu.core", "ServerOptSpec"),
     "FaultModel": ("blades_tpu.faults", "FaultModel"),
+    "AuditMonitor": ("blades_tpu.audit", "AuditMonitor"),
 }
 
 

@@ -40,6 +40,16 @@ class Aggregator:
     #: set by subclasses that carry state across rounds
     stateful: bool = False
 
+    #: Certification-contract opt-outs (``blades_tpu.audit``, enforced by
+    #: the tier-1 registry lint in ``tests/test_audit.py``): a mapping of
+    #: contract name (``"permutation"`` | ``"translation"`` |
+    #: ``"resilience"``) to a documented reason. Every registered aggregator
+    #: must either PASS each contract of the battery or carry an explicit
+    #: reason here — a new defense cannot silently skip certification.
+    #: Class-level and never mutated; subclasses override with their own
+    #: literal dict.
+    audit_optouts: dict = {}
+
     def init_state(self, num_clients: int, dim: int) -> Any:
         """Initial carry for stateful aggregators; ``()`` when stateless."""
         return ()

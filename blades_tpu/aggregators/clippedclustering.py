@@ -25,6 +25,16 @@ from blades_tpu.ops.masked import masked_median_1d
 class Clippedclustering(Aggregator):
     stateful = True
 
+    # certification opt-out (blades_tpu.audit): norm clipping to the
+    # historical-median radius and cosine-distance clustering are both
+    # origin-anchored — translating every update changes the clip set and
+    # the cluster features (resilience certifies; cert matrix).
+    audit_optouts = {
+        "translation": "median-norm clipping and cosine-distance clustering "
+                       "are origin-anchored; a global translation changes "
+                       "the clip and cluster decisions",
+    }
+
     def __init__(self, tau: float = None, history_cap: int = 65536):
         self.tau = tau
         self.history_cap = history_cap

@@ -171,6 +171,13 @@ class Asyncmean(Aggregator):
     straggler simulations.
     """
 
+    # certification opt-out (blades_tpu.audit): an (async) mean — breakdown
+    # point 0, same as Mean (see aggregators/mean.py).
+    audit_optouts = {
+        "resilience": "breakdown point 0: one unbounded byzantine row moves "
+                      "the (async) average arbitrarily far",
+    }
+
     def aggregate(self, updates, state=(), *, present: Optional[jnp.ndarray] = None, **ctx):
         k = updates.shape[0]
         if present is None:
@@ -194,6 +201,17 @@ class Asynccenteredclipping(Aggregator):
     damped by 1/K rather than 1/|present|."""
 
     stateful = True
+
+    # certification opt-out (blades_tpu.audit): one clipping iteration
+    # around an origin-initialized momentum — a global translation changes
+    # which differences the radius clips, so the single-step aggregate does
+    # not translate (the synchronous Centeredclipping converges over n_iter
+    # inner steps and passes; this variant deliberately under-steps).
+    audit_optouts = {
+        "translation": "single clipping step around the origin-anchored "
+                       "momentum; the 1/K-damped under-step does not "
+                       "translate with the updates",
+    }
 
     def __init__(self, tau: float = 10.0, n_iter: int = 1):
         self.tau = float(tau)

@@ -21,6 +21,17 @@ from blades_tpu.aggregators.base import Aggregator
 
 
 class Fltrust(Aggregator):
+    # certification opt-out (blades_tpu.audit): trust scores are cosine
+    # similarities to the trusted update and every update is rescaled to the
+    # trusted norm — both origin-anchored, so translating all updates does
+    # not translate the aggregate (by design: the server's root-of-trust
+    # direction is absolute, not relative).
+    audit_optouts = {
+        "translation": "cosine trust scores and trusted-norm rescaling are "
+                       "origin-anchored; the defense is deliberately not "
+                       "translation-equivariant",
+    }
+
     def __call__(self, inputs, **ctx):
         # host-side guard mirroring the reference's `assert len(trusted) == 1`
         mask = ctx.get("trusted_mask")

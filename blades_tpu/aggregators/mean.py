@@ -11,6 +11,15 @@ from blades_tpu.ops.masked import masked_mean
 class Mean(Aggregator):
     r"""Computes the sample mean over client updates: one XLA row reduction."""
 
+    # certification opt-out (blades_tpu.audit): averaging has breakdown
+    # point 0 — a single unbounded row moves the aggregate arbitrarily, so
+    # the empirical (f, c)-resilience bound cannot hold for any f >= 1 (the
+    # cert matrix records the breakdown; docs/robustness.md).
+    audit_optouts = {
+        "resilience": "breakdown point 0: one unbounded byzantine row moves "
+                      "the average arbitrarily far from the honest mean",
+    }
+
     def aggregate(self, updates, state=(), **ctx):
         return jnp.mean(updates, axis=0), state
 

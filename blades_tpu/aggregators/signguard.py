@@ -23,6 +23,16 @@ from blades_tpu.ops.masked import masked_median_1d
 
 
 class Signguard(Aggregator):
+    # certification opt-out (blades_tpu.audit): the norm band and the
+    # (pos, zero, neg) sign statistics are origin-anchored — translating
+    # every update changes both filters' features, so exact translation
+    # equivariance cannot hold (resilience still certifies; cert matrix).
+    audit_optouts = {
+        "translation": "norm-band and gradient-sign statistics are "
+                       "origin-anchored; a global translation changes which "
+                       "clients the filters keep",
+    }
+
     def __init__(self, lower: float = 0.1, upper: float = 3.0):
         self.lower = lower
         self.upper = upper

@@ -35,6 +35,16 @@ class Alie(Attack):
             return float(self._z)
         s = math.floor(n / 2 + 1) - f
         cdf_value = (n - f - s) / (n - f)
+        # feasibility edge: when f exceeds the paper's supported-majority
+        # regime (f > floor(n/2 + 1), e.g. f = n - 1), s goes negative and
+        # the cdf exceeds 1, where ppf returns NaN — which would silently
+        # NaN every byzantine row. Clamp into the open unit interval so
+        # degenerate populations still produce a finite (if extreme) z
+        # (pinned by tests/test_attackers.py). The bounds are epsilons, not
+        # 0.5: valid configs legitimately sit below 0.5 (even n with f=1
+        # gives cdf (n/2 - 1)/(n - 1) < 0.5) and must keep the reference's
+        # exact ppf value.
+        cdf_value = min(max(cdf_value, 1e-9), 1.0 - 1e-9)
         return float(norm.ppf(cdf_value))
 
     def on_updates(self, updates, byz_mask, key, state=()):

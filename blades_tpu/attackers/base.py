@@ -77,15 +77,25 @@ class NoAttack(Attack):
     ``num_byzantine=0``, ``simulator.py:118-121``)."""
 
 
-def honest_stats(updates: jnp.ndarray, byz_mask: jnp.ndarray):
+def honest_stats(
+    updates: jnp.ndarray, byz_mask: jnp.ndarray, part_mask: jnp.ndarray = None
+):
     """Masked per-coordinate mean and unbiased std over honest rows.
 
     Omniscient attacks (ALIE/IPM/minmax) need moments of the honest updates;
     with everything resident in one ``[K, D]`` device array this is two masked
     reductions instead of the reference's host-side loop over client objects
     (``alieclient.py:25-36``). Unbiased (ddof=1) std matches ``torch.std``.
+
+    ``part_mask`` optionally restricts the honest set to the participating
+    clients (partial participation, ``blades_tpu/faults``): the audit attack
+    search (``blades_tpu/audit``) models an adversary that only observes the
+    updates actually delivered this round. Degenerate honest sets stay
+    finite: zero honest rows yield ``mu = std = 0`` (the attack collapses to
+    the zero template), a single honest row yields ``std = 0``.
     """
-    honest = (~byz_mask).astype(updates.dtype)[:, None]
+    honest_rows = ~byz_mask if part_mask is None else (~byz_mask & part_mask)
+    honest = honest_rows.astype(updates.dtype)[:, None]
     n = jnp.maximum(honest.sum(), 1.0)
     mu = (updates * honest).sum(axis=0) / n
     var = ((updates - mu) ** 2 * honest).sum(axis=0) / jnp.maximum(n - 1.0, 1.0)

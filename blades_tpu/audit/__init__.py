@@ -1,0 +1,60 @@
+"""Defense certification & breakdown audit (docs/robustness.md).
+
+Three layers over the aggregator registry, all pure functions of the
+``[K, D]`` update matrix:
+
+- :mod:`~blades_tpu.audit.contracts` — the jitted contract battery
+  (permutation invariance, translation equivariance, empirical
+  (f, c)-resilience) every registered aggregator must pass or opt out of
+  with a documented reason (``Aggregator.audit_optouts``, enforced by the
+  tier-1 registry lint in ``tests/test_audit.py``);
+- :mod:`~blades_tpu.audit.attack_search` — the adaptive per-(aggregator, f)
+  worst-case attack search behind the committed breakdown matrix
+  (``scripts/certify.py`` -> ``results/certification/cert_matrix.json``);
+- :mod:`~blades_tpu.audit.monitor` — :class:`AuditMonitor`, the runtime
+  per-round certificates + certified graceful fallback traced into the
+  jitted round program (``core/engine.py``; ``audit`` telemetry records,
+  docs/observability.md).
+
+Reference counterpart: none — the reference neither measures nor reacts to
+defense breakdown (``src/blades/simulator.py:244``).
+"""
+
+from blades_tpu.audit.attack_search import (
+    DEFAULT_GRIDS,
+    QUICK_GRIDS,
+    TEMPLATE_NAMES,
+    search_cell,
+    synthetic_honest,
+)
+from blades_tpu.audit.contracts import (
+    CONTRACTS,
+    DEFAULT_C,
+    battery_ctx,
+    battery_kwargs,
+    check_permutation,
+    check_resilience,
+    check_translation,
+    nominal_f,
+    run_battery,
+)
+from blades_tpu.audit.monitor import CERTIFICATE_NAMES, AuditMonitor
+
+__all__ = [
+    "AuditMonitor",
+    "CERTIFICATE_NAMES",
+    "CONTRACTS",
+    "DEFAULT_C",
+    "DEFAULT_GRIDS",
+    "QUICK_GRIDS",
+    "TEMPLATE_NAMES",
+    "battery_ctx",
+    "battery_kwargs",
+    "check_permutation",
+    "check_resilience",
+    "check_translation",
+    "nominal_f",
+    "run_battery",
+    "search_cell",
+    "synthetic_honest",
+]

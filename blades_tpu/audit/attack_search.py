@@ -1,0 +1,286 @@
+"""Adaptive attack search: per-(aggregator, f) worst-case deviation.
+
+Shejwalkar & Houmansadr (NDSS'21) show that fixed attacks understate how
+badly an aggregator breaks — the adversary should *search* over attack
+hyperparameters for the worst feasible corruption. This module is that
+search, TPU-native: every template is a pure function of the ``[K, D]``
+update matrix and a scalar attack parameter, swept inside fixed-shape
+``lax`` loops (``lax.map`` grids, ``lax.fori_loop`` bisection) so one
+compiled program evaluates the whole search for a cell.
+
+Templates (>= 3 families, the satellites the literature actually uses):
+
+- ``ipm``      — Inner Product Manipulation: byz rows ``-eps * mu_h``,
+                 eps swept over a log grid (Xie et al., 2020);
+- ``alie``     — A Little Is Enough: byz rows ``mu_h - z * std_h``,
+                 z swept over a linear grid (Baruch et al., 2019);
+- ``signflip`` — scaled sign flip: byz rows ``-s * u_i``, s log grid;
+- ``minmax`` / ``minsum`` — AGR-agnostic envelope attacks: byz rows
+                 ``mu_h + gamma * dev`` with gamma found by fixed-iteration
+                 bisection against the honest pairwise-distance envelope
+                 (reference machinery: ``attackers/minmax.py``), swept over
+                 three perturbation directions (-std, -unit(mu), -sign(mu)).
+
+The figure of merit is the empirical (f, c)-resilience of Karimireddy et
+al. (2021, *Learning from History*): the aggregate must stay within a
+constant factor of the honest updates' spread,
+
+    ||agg(attacked) - mean(honest)|| <= c * max_i ||u_i - mean(honest)||.
+
+``search_cell`` reports, per template, the worst deviation/ratio the search
+found; ``scripts/certify.py`` drives it over the whole aggregator registry
+to produce the committed breakdown matrix
+(``results/certification/cert_matrix.json``, docs/robustness.md).
+
+Reference counterpart: none — the reference ships fixed attacks only and
+never measures aggregator breakdown (``src/blades/simulator.py:239-244``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.attackers.base import honest_stats
+from blades_tpu.ops.distances import pairwise_sq_euclidean
+
+TEMPLATE_NAMES = ("ipm", "alie", "signflip", "minmax", "minsum")
+
+#: the full-search grids (scripts/certify.py)
+DEFAULT_GRIDS: Dict[str, Any] = {
+    "ipm_eps": np.logspace(-1.0, 3.0, 9),
+    "alie_z": np.linspace(0.25, 4.0, 8),
+    "signflip_s": np.logspace(-1.0, 3.0, 9),
+    "n_bisect": 20,
+    "gamma_init": 10.0,
+}
+
+#: reduced grids for the tier-1 registry lint (tests/test_audit.py)
+QUICK_GRIDS: Dict[str, Any] = {
+    "ipm_eps": np.asarray([1.0, 100.0]),
+    "alie_z": np.asarray([1.5, 3.0]),
+    "signflip_s": np.asarray([1.0, 100.0]),
+    "n_bisect": 12,
+    "gamma_init": 10.0,
+}
+
+
+# -- attack templates ---------------------------------------------------------
+
+
+def ipm_rows(updates, byz_mask, eps, part_mask=None):
+    """Byz rows become ``-eps * mean(honest)`` (IPM with traced epsilon)."""
+    mu, _, _ = honest_stats(updates, byz_mask, part_mask)
+    return jnp.where(byz_mask[:, None], -eps * mu[None, :], updates)
+
+
+def alie_rows(updates, byz_mask, z, part_mask=None):
+    """Byz rows become ``mu - z * std`` over the honest set (ALIE with
+    traced z — the search's analogue of the ppf-derived static z_max)."""
+    mu, std, _ = honest_stats(updates, byz_mask, part_mask)
+    return jnp.where(byz_mask[:, None], (mu - z * std)[None, :], updates)
+
+
+def signflip_rows(updates, byz_mask, s, part_mask=None):
+    """Byz rows flip and scale their OWN update: ``-s * u_i``."""
+    return jnp.where(byz_mask[:, None], -s * updates, updates)
+
+
+def _unit(v, eps=1e-12):
+    return v / jnp.maximum(jnp.sqrt(jnp.sum(v * v)), eps)
+
+
+def dev_directions(updates, byz_mask, part_mask=None):
+    """The ``[3, D]`` min-max/min-sum perturbation directions of the NDSS'21
+    paper: negative honest std, negative unit honest mean, negative sign of
+    the honest mean."""
+    mu, std, _ = honest_stats(updates, byz_mask, part_mask)
+    return jnp.stack([-_unit(std), -_unit(mu), -_unit(jnp.sign(mu))])
+
+
+def _envelope_stats(updates, byz_mask, part_mask):
+    """Honest weights + masked pairwise squared distances (the feasibility
+    envelope both min-max and min-sum bisect against)."""
+    honest_rows = ~byz_mask if part_mask is None else (~byz_mask & part_mask)
+    honest_w = honest_rows.astype(updates.dtype)
+    sq = pairwise_sq_euclidean(updates) * (honest_w[:, None] * honest_w[None, :])
+    return honest_w, sq
+
+
+def _bisect_gamma(feasible, gamma_init, n_bisect, dtype):
+    """Fixed-iteration bisection for the largest feasible attack scale —
+    static control flow (``lax.fori_loop``), the jit-friendly form of the
+    reference's data-driven loop (``attackers/minmax.py``)."""
+
+    def body(_, carry):
+        gamma, step = carry
+        gamma = jnp.where(feasible(gamma), gamma + step, gamma - step)
+        return gamma, step / 2.0
+
+    gamma0 = jnp.asarray(gamma_init, dtype)
+    gamma, _ = lax.fori_loop(0, n_bisect, body, (gamma0, gamma0 / 2.0))
+    # the degenerate envelope (one honest row -> all-zero pairwise
+    # distances) drives the bisection to ~0; never below it
+    return jnp.maximum(gamma, 0.0)
+
+
+def minmax_rows(updates, byz_mask, dev, part_mask=None,
+                n_bisect=20, gamma_init=10.0):
+    """Min-Max: largest gamma with max distance from the malicious point to
+    any honest update inside the max pairwise honest distance."""
+    mu, _, _ = honest_stats(updates, byz_mask, part_mask)
+    honest_w, sq = _envelope_stats(updates, byz_mask, part_mask)
+
+    def feasible(gamma):
+        mal = mu + gamma * dev
+        d = ((updates - mal[None, :]) ** 2).sum(axis=1) * honest_w
+        return d.max() <= sq.max()
+
+    gamma = _bisect_gamma(feasible, gamma_init, n_bisect, updates.dtype)
+    return jnp.where(byz_mask[:, None], (mu + gamma * dev)[None, :], updates)
+
+
+def minsum_rows(updates, byz_mask, dev, part_mask=None,
+                n_bisect=20, gamma_init=10.0):
+    """Min-Sum: largest gamma with the malicious point's summed squared
+    distance to the honest set inside the worst honest row's."""
+    mu, _, _ = honest_stats(updates, byz_mask, part_mask)
+    honest_w, sq = _envelope_stats(updates, byz_mask, part_mask)
+
+    def feasible(gamma):
+        mal = mu + gamma * dev
+        d = (((updates - mal[None, :]) ** 2).sum(axis=1) * honest_w).sum()
+        return d <= sq.sum(axis=1).max()
+
+    gamma = _bisect_gamma(feasible, gamma_init, n_bisect, updates.dtype)
+    return jnp.where(byz_mask[:, None], (mu + gamma * dev)[None, :], updates)
+
+
+# -- the per-cell search ------------------------------------------------------
+
+
+def honest_reference(updates, byz_mask, part_mask=None):
+    """``(mu_h, rho)``: honest mean and max honest deviation from it — the
+    two sides of the empirical (f, c)-resilience bound."""
+    honest_rows = ~byz_mask if part_mask is None else (~byz_mask & part_mask)
+    mu, _, _ = honest_stats(updates, byz_mask, part_mask)
+    dev = jnp.sqrt(jnp.maximum(((updates - mu) ** 2).sum(axis=1), 0.0))
+    rho = jnp.max(jnp.where(honest_rows, dev, 0.0))
+    return mu, rho
+
+
+def search_cell(
+    agg: Aggregator,
+    trials_updates: jnp.ndarray,
+    f: int,
+    *,
+    ctx: Optional[dict] = None,
+    grids: Optional[dict] = None,
+    part_mask: Optional[jnp.ndarray] = None,
+    use_jit: bool = False,
+) -> Dict[str, Any]:
+    """Worst-case deviation search for one (aggregator, f) cell.
+
+    ``trials_updates``: ``[T, K, D]`` honest update draws (the search runs
+    per trial and reports the worst). ``f`` is static (the aggregator's own
+    hyperparameters are static anyway); the byzantine rows are the first
+    ``f`` ids, matching the engine convention (``core/engine.py:227``).
+    The aggregator is evaluated single-shot from a fresh ``init_state``
+    (stateful defenses certify their first-round behavior; docs note).
+
+    Returns ``{"templates": {name: {"worst_dev", "worst_ratio"}},
+    "worst_dev", "worst_ratio", "rho"}`` — ratio is deviation over the
+    per-trial max honest deviation ``rho`` (floored at 1e-9).
+    """
+    if trials_updates.ndim == 2:
+        trials_updates = trials_updates[None]
+    t, k, d = trials_updates.shape
+    ctx = dict(ctx or {})
+    g = dict(DEFAULT_GRIDS)
+    g.update(grids or {})
+    n_bisect = int(g["n_bisect"])
+    gamma_init = float(g["gamma_init"])
+    byz_mask = jnp.arange(k) < f
+
+    def aggregate(u):
+        state = agg.init_state(k, d)
+        out, _ = agg.aggregate_masked(u, state, mask=part_mask, **ctx)
+        return out
+
+    def one_trial(u):
+        mu_h, rho = honest_reference(u, byz_mask, part_mask)
+
+        def deviation(attacked):
+            return jnp.sqrt(
+                jnp.maximum(jnp.sum((aggregate(attacked) - mu_h) ** 2), 0.0)
+            )
+
+        def sweep(template, grid):
+            return jnp.max(
+                lax.map(lambda p: deviation(template(u, byz_mask, p, part_mask)),
+                        jnp.asarray(grid, u.dtype))
+            )
+
+        def sweep_env(template):
+            devs = dev_directions(u, byz_mask, part_mask)
+            return jnp.max(
+                lax.map(
+                    lambda dv: deviation(
+                        template(u, byz_mask, dv, part_mask,
+                                 n_bisect=n_bisect, gamma_init=gamma_init)
+                    ),
+                    devs,
+                )
+            )
+
+        per_template = jnp.stack([
+            sweep(ipm_rows, g["ipm_eps"]),
+            sweep(alie_rows, g["alie_z"]),
+            sweep(signflip_rows, g["signflip_s"]),
+            sweep_env(minmax_rows),
+            sweep_env(minsum_rows),
+        ])
+        return per_template, rho
+
+    def run(trials):
+        return lax.map(one_trial, trials)
+
+    if use_jit:
+        run = jax.jit(run)
+    devs, rhos = run(trials_updates)  # [T, 5], [T]
+    devs = np.asarray(devs, dtype=np.float64)
+    rhos = np.maximum(np.asarray(rhos, dtype=np.float64), 1e-9)
+    ratios = devs / rhos[:, None]
+    templates = {
+        name: {
+            "worst_dev": float(devs[:, i].max()),
+            "worst_ratio": float(ratios[:, i].max()),
+        }
+        for i, name in enumerate(TEMPLATE_NAMES)
+    }
+    return {
+        "templates": templates,
+        "worst_dev": float(devs.max()),
+        "worst_ratio": float(ratios.max()),
+        "rho": float(rhos.mean()),
+    }
+
+
+def synthetic_honest(
+    key: jax.Array, trials: int, k: int, d: int,
+    center_scale: float = 2.0, spread: float = 1.0,
+) -> jnp.ndarray:
+    """``[T, K, D]`` synthetic honest update draws: a shared per-trial
+    center of norm ~``center_scale`` plus iid per-row noise of norm
+    ~``spread`` — so the max honest deviation ``rho`` is ~``spread`` and
+    scale-sensitive defenses (clipping radii, norm filters) can be
+    instantiated against a known scale (docs/robustness.md)."""
+    kc, ku = jax.random.split(key)
+    centers = center_scale * jax.random.normal(kc, (trials, 1, d)) / np.sqrt(d)
+    noise = spread * jax.random.normal(ku, (trials, k, d)) / np.sqrt(d)
+    return (centers + noise).astype(jnp.float32)

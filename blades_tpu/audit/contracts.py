@@ -1,0 +1,219 @@
+"""Jitted contract battery for robust aggregators.
+
+Karimireddy et al. (2021, *Learning from History*) frame Byzantine
+robustness as a checkable bound rather than a narrative property; this
+module makes three such properties executable over the whole aggregator
+registry (``blades_tpu/aggregators``), both as tier-1 test properties
+(``tests/test_audit.py`` — the registry lint) and as a sweep
+(``scripts/certify.py``):
+
+- ``permutation``  — client order cannot matter:
+                     ``agg(P u) == agg(u)`` for a random permutation ``P``
+                     (any ``[K]``-shaped context array, e.g. FLTrust's
+                     ``trusted_mask``, is permuted along);
+- ``translation``  — shifting every update shifts the aggregate:
+                     ``agg(u + t) == agg(u) + t``. Origin-anchored defenses
+                     (cosine trust, norm filters, clipping around a zero
+                     momentum) legitimately fail this and declare a
+                     documented opt-out (``Aggregator.audit_optouts``);
+- ``resilience``   — the empirical (f, c)-bound under the adaptive attack
+                     search (``blades_tpu/audit/attack_search``):
+                     ``||agg(attacked) - mean(honest)|| <= c * rho`` with
+                     ``rho`` the max honest deviation.
+
+Every check is a pure function over a ``[K, D]`` matrix, so the battery
+runs eagerly on tiny matrices in the lint (no compile cost) and jitted
+inside the certification sweep. Reference counterpart: none — the
+reference has no tests and no contract surface at all (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.audit.attack_search import (
+    QUICK_GRIDS,
+    search_cell,
+    synthetic_honest,
+)
+
+CONTRACTS = ("permutation", "translation", "resilience")
+
+#: default (f, c) resilience constant: any aggregate inside the min-max
+#: feasibility envelope is within 3 rho of the honest mean (a malicious
+#: point within the honest pairwise diameter is <= 2 rho from some honest
+#: update, itself <= rho from the honest mean), so c = 3 is the natural
+#: "constant factor of the honest spread" the certificate asks for.
+DEFAULT_C = 3.0
+
+_RTOL = 1e-3
+_ATOL = 1e-4
+
+
+def nominal_f(name: str, k: int) -> int:
+    """The largest byzantine count the named defense nominally tolerates at
+    population ``k`` — the f at which the certification matrix expects the
+    cell to pass (docs/robustness.md):
+
+    - ``mean``/``asyncmean``: 0 (breakdown point 0 — one unbounded row
+      moves the average arbitrarily);
+    - ``krum``/``multikrum``: ``(k - 3) // 2`` (Blanchard et al. need
+      ``k >= 2f + 3``);
+    - everything else (median family, geometric medians, clustering,
+      clipping, filters): honest majority, ``(k - 1) // 2``.
+    """
+    if name in ("mean", "asyncmean"):
+        return 0
+    if name in ("krum", "multikrum"):
+        return max((k - 3) // 2, 0)
+    return max((k - 1) // 2, 0)
+
+
+def battery_kwargs(name: str, k: int, f: int) -> Dict[str, Any]:
+    """Constructor kwargs certifying cell (name, f) at population ``k``.
+
+    Defenses that take a byzantine budget get the cell's ``f``; multikrum's
+    selection width shrinks to the Blanchard-safe ``k - 2f - 2``; the
+    clipping radii are instantiated at 2x the honest deviation scale of
+    :func:`~blades_tpu.audit.attack_search.synthetic_honest` (``spread=1``)
+    — tau is a scale hyperparameter, and certifying a radius wildly off the
+    data scale would measure the mis-configuration, not the defense.
+    """
+    if name in ("krum", "trimmedmean", "dnc"):
+        return {"num_byzantine": f}
+    if name == "multikrum":
+        return {"num_byzantine": f, "num_selected": max(k - 2 * f - 2, 1)}
+    if name in ("centeredclipping", "asynccenteredclipping"):
+        return {"tau": 2.0}
+    if name == "byzantinesgd":
+        return {"th_A": 10.0, "th_B": 2.0, "th_V": 1.0}
+    return {}
+
+
+def battery_ctx(agg: Aggregator, k: int, d: int, key=None) -> Dict[str, Any]:
+    """The aggregation context the battery supplies (mirrors what the
+    engine passes every round, ``core/engine.py``): a trusted-client mask
+    with the LAST client trusted (honest — byzantine ids are the prefix),
+    the flat parameter vector, and an rng key."""
+    return {
+        "trusted_mask": jnp.zeros(k, bool).at[k - 1].set(True),
+        "params_flat": jnp.zeros(d, jnp.float32),
+        "key": key if key is not None else jax.random.PRNGKey(7),
+    }
+
+
+def _residual_ok(a, b, scale=0.0):
+    res = float(jnp.sqrt(jnp.maximum(jnp.sum((a - b) ** 2), 0.0)))
+    ref = float(jnp.sqrt(jnp.maximum(jnp.sum(a * a), 0.0))) + float(scale)
+    return res, res <= _ATOL + _RTOL * ref
+
+
+def _permute_ctx(ctx: dict, perm: jnp.ndarray, k: int) -> dict:
+    out = {}
+    for name, v in ctx.items():
+        arr = jnp.asarray(v) if not isinstance(v, jax.Array) else v
+        if (
+            getattr(arr, "ndim", 0) >= 1
+            and arr.shape[0] == k
+            and name not in ("params_flat", "key")
+        ):
+            out[name] = arr[perm]
+        else:
+            out[name] = v
+    return out
+
+
+def check_permutation(agg: Aggregator, updates, ctx=None, key=None) -> Dict[str, Any]:
+    """``agg(P u) == agg(u)`` for a random permutation P (within float
+    tolerance — reduction orders legitimately reorder float sums)."""
+    k, d = updates.shape
+    ctx = dict(ctx or {})
+    key = key if key is not None else jax.random.PRNGKey(11)
+    perm = jax.random.permutation(key, k)
+    a, _ = agg.aggregate(updates, agg.init_state(k, d), **ctx)
+    b, _ = agg.aggregate(updates[perm], agg.init_state(k, d),
+                         **_permute_ctx(ctx, perm, k))
+    res, ok = _residual_ok(a, b)
+    return {"contract": "permutation", "residual": res, "ok": bool(ok)}
+
+
+def check_translation(agg: Aggregator, updates, ctx=None, key=None) -> Dict[str, Any]:
+    """``agg(u + t) == agg(u) + t`` for a random translation t."""
+    k, d = updates.shape
+    ctx = dict(ctx or {})
+    key = key if key is not None else jax.random.PRNGKey(13)
+    t = 3.0 * jax.random.normal(key, (d,), updates.dtype) / np.sqrt(d)
+    a, _ = agg.aggregate(updates, agg.init_state(k, d), **ctx)
+    b, _ = agg.aggregate(updates + t[None, :], agg.init_state(k, d), **ctx)
+    res, ok = _residual_ok(a + t, b, scale=float(jnp.linalg.norm(t)))
+    return {"contract": "translation", "residual": res, "ok": bool(ok)}
+
+
+def check_resilience(
+    agg: Aggregator,
+    trials_updates,
+    f: int,
+    *,
+    ctx=None,
+    c: float = DEFAULT_C,
+    grids: Optional[dict] = None,
+    use_jit: bool = False,
+) -> Dict[str, Any]:
+    """Empirical (f, c)-resilience under the adaptive attack search: the
+    worst deviation over all templates stays within ``c`` times the honest
+    spread."""
+    cell = search_cell(agg, trials_updates, f, ctx=ctx, grids=grids,
+                       use_jit=use_jit)
+    return {
+        "contract": "resilience",
+        "f": int(f),
+        "c": float(c),
+        "worst_ratio": cell["worst_ratio"],
+        "worst_dev": cell["worst_dev"],
+        "rho": cell["rho"],
+        "templates": cell["templates"],
+        "ok": bool(cell["worst_ratio"] <= c),
+    }
+
+
+def run_battery(
+    agg: Aggregator,
+    *,
+    k: int = 8,
+    d: int = 16,
+    f: Optional[int] = None,
+    name: Optional[str] = None,
+    c: float = DEFAULT_C,
+    trials: int = 1,
+    seed: int = 0,
+    grids: Optional[dict] = None,
+    use_jit: bool = False,
+) -> Dict[str, Dict[str, Any]]:
+    """Run all three contracts against one aggregator instance; returns
+    ``{contract: result}`` with each result carrying ``ok`` plus the
+    measured residual/ratio. ``f`` defaults to ``max(1, nominal_f)`` so the
+    resilience check is never vacuous — aggregators with breakdown point 0
+    (mean) fail it and must declare the documented opt-out.
+    """
+    name = name or type(agg).__name__.lower()
+    if f is None:
+        f = max(1, nominal_f(name, k))
+    key = jax.random.PRNGKey(seed)
+    k_data, k_perm, k_trans, k_ctx = jax.random.split(key, 4)
+    trials_updates = synthetic_honest(k_data, trials, k, d)
+    u0 = trials_updates[0]
+    ctx = battery_ctx(agg, k, d, key=k_ctx)
+    return {
+        "permutation": check_permutation(agg, u0, ctx, key=k_perm),
+        "translation": check_translation(agg, u0, ctx, key=k_trans),
+        "resilience": check_resilience(
+            agg, trials_updates, f, ctx=ctx, c=c,
+            grids=grids if grids is not None else QUICK_GRIDS,
+            use_jit=use_jit,
+        ),
+    }

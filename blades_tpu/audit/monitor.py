@@ -1,0 +1,209 @@
+"""Runtime robustness contracts: per-round certificates + certified fallback.
+
+The certification sweep (``scripts/certify.py``) measures breakdown
+offline; this module watches for it *during* a run. Two cheap certificates
+are traced into the SAME jitted round program as training and aggregation
+(``core/engine.py`` — zero extra compiles, pinned by the compile-counter
+telemetry in ``tests/test_audit.py``):
+
+- ``median_ball`` — the applied aggregate stays within
+  ``median_ball_factor`` times the participants' robust spread of their
+  coordinate-wise median:
+  ``||agg - med|| <= factor * median_i ||u_i - med||``. This is the
+  oracle-free form of the (f, c)-resilience bound: the coordinate-wise
+  median and the median distance to it are both f < n/2 robust estimates
+  of the honest center/spread, so an aggregate that leaves the ball has
+  been dragged further than any honest-majority statistic can justify
+  (Karimireddy et al., 2021);
+- ``envelope`` — the aggregate stays inside the participants'
+  pairwise-distance envelope:
+  ``max_i ||agg - u_i|| <= envelope_factor * max_ij ||u_i - u_j||``
+  (an aggregate outside the delivered point cloud is never justified).
+
+A breach is a per-round boolean; with ``fallback_aggregator=`` set, the
+round that breaches applies a safe defense's aggregate instead (computed
+in-graph alongside the primary — the swap is a ``where``, so a
+breach->fallback round is bit-reproducible under a fixed seed, including
+across kill/resume). This composes with the fault layer: certificates run
+over the participating subset only, and guard-excluded NaN rows are zeroed
+before any certificate arithmetic (masked-row inertness extends to the
+audit, ``scripts/chaos.py``).
+
+Reference counterpart: none — the reference applies whatever the
+aggregator returns, unconditionally (``src/blades/simulator.py:244``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+
+from blades_tpu.ops.distances import pairwise_sq_euclidean
+from blades_tpu.ops.masked import masked_mean, masked_median, masked_median_1d
+
+CERTIFICATE_NAMES = ("median_ball", "envelope")
+
+
+def _norm(v):
+    return jnp.sqrt(jnp.maximum(jnp.sum(v * v), 0.0))
+
+
+def _row_dists(rows, point):
+    return jnp.sqrt(jnp.maximum(jnp.sum((rows - point[None, :]) ** 2, axis=1), 0.0))
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditMonitor:
+    """Round-level robustness certificates with optional certified fallback.
+
+    Parameters
+    ----------
+    median_ball_factor : the ``c`` of the median-ball certificate.
+        Default 3.0 — the same constant the offline (f, c)-resilience
+        certification uses (``blades_tpu.audit.contracts.DEFAULT_C``).
+    envelope_factor : slack multiplier on the pairwise-distance envelope.
+    certificates : which certificates gate the breach flag (both are always
+        *recorded*; this selects which ones can trigger fallback).
+    fallback_aggregator : registry name or :class:`Aggregator` instance
+        swapped in for any round whose enforced certificates breach.
+        Must be stateless (the fallback runs from a fresh empty state every
+        round — a stateful fallback would need its state threaded through
+        rounds it does not own); ``median`` is the canonical choice.
+
+    Instances ride on the engine like a FaultModel: construction-time
+    hyperparameters are static under jit, and every method is a pure
+    function traced into the round program.
+    """
+
+    median_ball_factor: float = 3.0
+    envelope_factor: float = 1.0
+    certificates: Tuple[str, ...] = ("median_ball", "envelope")
+    fallback_aggregator: Any = None
+
+    def __post_init__(self):
+        certs = tuple(self.certificates)
+        for c in certs:
+            if c not in CERTIFICATE_NAMES:
+                raise ValueError(
+                    f"unknown certificate {c!r}; available: {CERTIFICATE_NAMES}"
+                )
+        if not certs:
+            raise ValueError("certificates must name at least one certificate")
+        object.__setattr__(self, "certificates", certs)
+        fb = self.fallback_aggregator
+        if isinstance(fb, str):
+            from blades_tpu.aggregators import get_aggregator
+
+            fb = get_aggregator(fb)
+        if fb is not None and getattr(fb, "stateful", False):
+            raise ValueError(
+                f"fallback aggregator {fb!r} is stateful; the fallback runs "
+                "from a fresh state each breached round — use a stateless "
+                "defense (median/trimmedmean/geomed)"
+            )
+        object.__setattr__(self, "fallback_aggregator", fb)
+
+    # -- the in-graph certificate pass ---------------------------------------
+
+    def certify(
+        self, updates: jnp.ndarray, agg: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, dict]:
+        """Evaluate both certificates on the (participating subset of the)
+        update matrix against a candidate aggregate.
+
+        Returns ``(breach, diag)``: a scalar bool (True when any ENFORCED
+        certificate fails on a round with >= 1 participant) and the full
+        forensic dict. Masked-out rows are zeroed first, so excluded
+        NaN/Inf payloads cannot poison the certificate arithmetic.
+        """
+        k = updates.shape[0]
+        m = jnp.ones(k, bool) if mask is None else jnp.asarray(mask).astype(bool)
+        safe = jnp.where(m[:, None], updates, 0.0)
+        n = jnp.sum(m.astype(jnp.int32))
+
+        med = masked_median(safe, m)
+        r_hat = masked_median_1d(_row_dists(safe, med), m)
+        dev_med = _norm(agg - med)
+        slack_med = 1e-6 * (1.0 + _norm(med))
+        median_ok = dev_med <= self.median_ball_factor * r_hat + slack_med
+
+        d2 = pairwise_sq_euclidean(safe)
+        pair = m[:, None] & m[None, :]
+        diameter = jnp.sqrt(jnp.maximum(jnp.max(jnp.where(pair, d2, 0.0)), 0.0))
+        agg_reach = jnp.max(jnp.where(m, _row_dists(safe, agg), 0.0))
+        slack_env = 1e-6 * (1.0 + diameter)
+        envelope_ok = agg_reach <= self.envelope_factor * diameter + slack_env
+
+        ok = jnp.ones((), bool)
+        if "median_ball" in self.certificates:
+            ok = ok & median_ok
+        if "envelope" in self.certificates:
+            ok = ok & envelope_ok
+        breach = (n > 0) & ~ok
+        diag = {
+            "participants": n,
+            "cert_median_ball": median_ok.astype(jnp.int32),
+            "cert_envelope": envelope_ok.astype(jnp.int32),
+            "dev_median": dev_med,
+            "spread_median": r_hat,
+            "diameter": diameter,
+        }
+        return breach, diag
+
+    def apply(
+        self,
+        updates: jnp.ndarray,
+        agg: jnp.ndarray,
+        *,
+        mask: Optional[jnp.ndarray] = None,
+        byz_mask: Optional[jnp.ndarray] = None,
+        **ctx,
+    ) -> Tuple[jnp.ndarray, dict]:
+        """Certify ``agg``; on breach, swap in the fallback aggregate (when
+        configured). ``ctx`` is the engine's aggregation context (trusted
+        mask, flat params, rng key) forwarded to the fallback.
+
+        ``byz_mask`` (the simulator's ground-truth oracle, unavailable in a
+        real deployment) adds honest-reference forensics to the diag: the
+        applied aggregate's deviation from the honest participating mean
+        and the max honest deviation — the two sides of the (f, c) bound,
+        recorded per round for the chaos suite's deviation invariant.
+        """
+        breach, diag = self.certify(updates, agg, mask)
+        k = updates.shape[0]
+        m = jnp.ones(k, bool) if mask is None else jnp.asarray(mask).astype(bool)
+        safe = jnp.where(m[:, None], updates, 0.0)
+
+        final = agg
+        fallback_used = jnp.zeros((), bool)
+        if self.fallback_aggregator is not None:
+            fb, _ = self.fallback_aggregator.aggregate_masked(
+                updates, (), mask=mask, **ctx
+            )
+            final = jnp.where(breach, fb, agg)
+            fallback_used = breach
+
+        diag["breach"] = breach.astype(jnp.int32)
+        diag["fallback_used"] = fallback_used.astype(jnp.int32)
+        diag["agg_norm"] = _norm(final)
+        if byz_mask is not None:
+            honest = m & ~byz_mask
+            nh = jnp.sum(honest.astype(jnp.int32))
+            mu_h = masked_mean(safe, honest)
+            hd = jnp.max(jnp.where(honest, _row_dists(safe, mu_h), 0.0))
+            has_h = nh > 0
+            diag["honest_participants"] = nh
+            diag["max_honest_dev"] = jnp.where(has_h, hd, 0.0)
+            diag["dev_honest"] = jnp.where(has_h, _norm(final - mu_h), 0.0)
+            diag["dev_honest_raw"] = jnp.where(has_h, _norm(agg - mu_h), 0.0)
+        return final, diag
+
+    def __repr__(self) -> str:
+        parts = [f"certs={'+'.join(self.certificates)}",
+                 f"c={self.median_ball_factor}"]
+        if self.fallback_aggregator is not None:
+            parts.append(f"fallback={self.fallback_aggregator!r}")
+        return f"AuditMonitor({', '.join(parts)})"

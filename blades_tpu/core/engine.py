@@ -33,6 +33,7 @@ from jax import lax
 
 from blades_tpu.aggregators.base import Aggregator
 from blades_tpu.attackers.base import Attack, NoAttack
+from blades_tpu.audit.monitor import AuditMonitor
 from blades_tpu.faults import FaultModel
 from blades_tpu.ops.pytree import make_unraveler, ravel
 from blades_tpu.parallel.mesh import ShardingPlan
@@ -153,6 +154,7 @@ class RoundEngine:
         donate_batches: bool = False,
         collect_diagnostics: bool = False,
         fault_model: Optional[FaultModel] = None,
+        audit_monitor: Optional[AuditMonitor] = None,
     ):
         """``client_chunks``: split the K client axis into this many
         sequential chunks (``lax.map`` outside, vmap inside). Each chunk still
@@ -196,7 +198,15 @@ class RoundEngine:
         ``Aggregator.aggregate_masked`` surface over the participating
         subset, and per-round fault counters land in
         ``self.last_fault_diag``. ``None`` (default) compiles the exact
-        pre-fault program."""
+        pre-fault program.
+
+        ``audit_monitor``: a :class:`blades_tpu.audit.AuditMonitor` tracing
+        per-round robustness certificates (median-ball, pairwise-distance
+        envelope) into the SAME jitted round program — zero extra compiles
+        — with an optional stateless fallback aggregator swapped in (one
+        ``where``) for any round whose enforced certificates breach.
+        Certificate/fallback forensics land in ``self.last_audit_diag``.
+        ``None`` (default) compiles the exact pre-audit program."""
         self.train_loss_fn = train_loss_fn
         self.eval_logits_fn = eval_logits_fn
         self.num_clients = int(num_clients)
@@ -220,6 +230,8 @@ class RoundEngine:
         self.last_diagnostics: Any = None
         self.fault_model = fault_model
         self.last_fault_diag: Any = None
+        self.audit_monitor = audit_monitor
+        self.last_audit_diag: Any = None
 
         self.dim, self.unravel = make_unraveler(params_template)
         # Reference convention: the FIRST num_byzantine client ids are
@@ -477,6 +489,19 @@ class RoundEngine:
                 jnp.sum(part_mask.astype(jnp.int32)) > 0, agg, jnp.zeros_like(agg)
             )
 
+        # runtime robustness audit (static branch — without a monitor the
+        # compiled program is exactly the pre-audit one): certificates over
+        # the participating subset, breach -> in-graph fallback swap, all
+        # inside this same program. The fallback gets the same aggregation
+        # context the primary defense saw (sans the mask, passed apart).
+        audit_diag = {}
+        if self.audit_monitor is not None:
+            audit_ctx = {k: v for k, v in agg_ctx.items() if k != "mask"}
+            agg, audit_diag = self.audit_monitor.apply(
+                updates, agg, mask=part_mask, byz_mask=self.byz_mask,
+                **audit_ctx,
+            )
+
         # server pseudo-gradient step: grad := -agg (server.py:54-75)
         grad_tree = self.unravel(-agg)
         server_updates, server_opt_state = self._server_tx.update(
@@ -520,6 +545,7 @@ class RoundEngine:
             updates if self.keep_updates else (),
             agg_diag,
             fault_diag,
+            audit_diag,
         )
 
     def run_round(
@@ -545,7 +571,14 @@ class RoundEngine:
         measures trace+enqueue cost, NOT device execution — callers that
         want the device wall time block inside their own span."""
         with get_recorder().span("dispatch"):
-            new_state, metrics, updates, agg_diag, fault_diag = self._round_jit(
+            (
+                new_state,
+                metrics,
+                updates,
+                agg_diag,
+                fault_diag,
+                audit_diag,
+            ) = self._round_jit(
                 state,
                 cx,
                 cy,
@@ -556,6 +589,9 @@ class RoundEngine:
         self.last_updates = updates if self.keep_updates else None
         self.last_diagnostics = agg_diag if self.collect_diagnostics else None
         self.last_fault_diag = fault_diag if self.fault_model is not None else None
+        self.last_audit_diag = (
+            audit_diag if self.audit_monitor is not None else None
+        )
         return new_state, metrics
 
     # -- evaluation ----------------------------------------------------------

@@ -30,6 +30,7 @@ import numpy as np
 from blades_tpu.aggregators import get_aggregator
 from blades_tpu.attackers import ATTACKS, get_attack
 from blades_tpu.attackers.base import Attack, NoAttack
+from blades_tpu.audit.monitor import AuditMonitor
 from blades_tpu.client import BladesClient, ByzantineClient
 from blades_tpu.core import ClientOptSpec, RoundEngine, ServerOptSpec
 from blades_tpu.core.engine import multistep_lr
@@ -312,6 +313,7 @@ class Simulator:
         donate_batches: bool = False,
         collect_diagnostics: Optional[bool] = None,
         fault_model: Optional[Union[FaultModel, Dict]] = None,
+        audit_monitor: Optional[Union[AuditMonitor, Dict]] = None,
     ) -> List[float]:
         """Run adversarial training; returns per-round wall times (reference
         ``run`` contract, ``simulator.py:364-457``).
@@ -344,6 +346,16 @@ class Simulator:
         auto-checkpoints the state (to ``checkpoint_path``, or
         ``<log_path>/autosave`` when none is set) so ``resume=True``
         restarts bit-exactly. See ``docs/robustness.md``.
+        ``audit_monitor``: a :class:`blades_tpu.audit.AuditMonitor` (or a
+        kwargs dict for one) tracing per-round robustness certificates —
+        aggregate inside the participants' pairwise-distance envelope and
+        within a ball of the coordinate-wise median — into the same jitted
+        round program (zero extra compiles), with an optional stateless
+        ``fallback_aggregator`` swapped in for any breached round.
+        Per-round certificate/fallback forensics land in the telemetry
+        trace as ``audit`` records (``docs/observability.md``); breach ->
+        fallback rounds are bit-reproducible under a fixed seed, including
+        across kill/resume.
 
         Telemetry (``docs/observability.md``): unless ``BLADES_TELEMETRY=0``,
         a span/counter trace of the run is appended to
@@ -375,6 +387,8 @@ class Simulator:
         ) or None
         if isinstance(fault_model, dict):
             fault_model = FaultModel(**fault_model)
+        if isinstance(audit_monitor, dict):
+            audit_monitor = AuditMonitor(**audit_monitor)
         trace_path = os.path.join(self.log_path, "telemetry.jsonl")
         # the log-dir wipe preserves the trace for kill -> relaunch
         # post-mortems, but a FRESH unsupervised run is a NEW experiment:
@@ -400,6 +414,11 @@ class Simulator:
                 **(
                     {"fault_model": repr(fault_model)}
                     if fault_model is not None
+                    else {}
+                ),
+                **(
+                    {"audit_monitor": repr(audit_monitor)}
+                    if audit_monitor is not None
                     else {}
                 ),
             },
@@ -465,6 +484,7 @@ class Simulator:
             donate_batches=donate_batches,
             collect_diagnostics=collect_diagnostics,
             fault_model=fault_model,
+            audit_monitor=audit_monitor,
         )
         state = self.engine.init(params)
 
@@ -540,6 +560,7 @@ class Simulator:
                     self.log_variance(rnd, m)
                     self._log_defense(rnd)
                     self._log_faults(rnd)
+                    self._log_audit(rnd)
                     if retain_updates:
                         # populate reference-parity client.get_update() views
                         for i, c in enumerate(self.get_clients()):
@@ -764,6 +785,30 @@ class Simulator:
         for name, value in fields.items():
             self.telemetry.gauge(f"faults.{name}", value)
         self.telemetry.event("faults", round=rnd, **fields)
+
+    def _log_audit(self, rnd: int) -> None:
+        """Runtime-audit forensics -> one ``audit`` telemetry record per
+        round: certificate verdicts (median-ball, envelope), breach /
+        fallback flags, and the oracle honest-deviation fields (the two
+        sides of the (f, c)-resilience bound — ground truth the simulator
+        knows but a real deployment would not). The headline flags also
+        land as gauges so every ``round`` record carries the latest values.
+        Reference counterpart: none (``src/blades/simulator.py:244``
+        applies whatever the aggregator returns, unaudited)."""
+        diag = getattr(self.engine, "last_audit_diag", None)
+        if not diag or not self.telemetry.enabled:
+            return
+        fields = {}
+        for name, v in diag.items():
+            arr = np.asarray(v)
+            fields[name] = arr.item() if arr.ndim == 0 else arr.tolist()
+        for name in ("breach", "fallback_used", "dev_honest"):
+            if name in fields:
+                self.telemetry.gauge(f"audit.{name}", fields[name])
+        self.telemetry.counter("audit.breaches", fields.get("breach", 0))
+        self.telemetry.event(
+            "audit", round=rnd, agg=repr(self.aggregator), **fields
+        )
 
     def evaluate(self, rnd: int, batch_size: int = 64) -> Dict:
         """Reference test flow (``test_actor`` -> ``log_validate``,

@@ -61,6 +61,7 @@ GALLERY = [
     ("telemetry_trace.py", ["--rounds", "2", "--out", "@TMP@"], {}, 600),
     ("fault_injection.py",
      ["--rounds", "2", "--out", "@TMP@", "--aggs", "median"], {}, 900),
+    ("defense_audit.py", ["--rounds", "2", "--out", "@TMP@"], {}, 900),
     ("supervised_run.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
     ("fedavg_ipm.py",
      ["--rounds", "2", "--steps", "2", "--out", "@TMP@"], {}, 900),
@@ -83,6 +84,10 @@ API_MODULES = [
     "blades_tpu.aggregators",
     "blades_tpu.attackers",
     "blades_tpu.faults",
+    "blades_tpu.audit",
+    "blades_tpu.audit.contracts",
+    "blades_tpu.audit.attack_search",
+    "blades_tpu.audit.monitor",
     "blades_tpu.datasets.fl",
     "blades_tpu.datasets.base",
     "blades_tpu.models",

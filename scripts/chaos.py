@@ -18,7 +18,16 @@ per scenario (``check_invariants``):
 3. **masked-row inertness, end to end** — re-running the scenario with the
    corrupted rows' *content* swapped (NaN <-> Inf) yields bit-identical
    final parameters: excluded payload content cannot leak into the model;
-4. (supervised scenarios, ``--child`` mode) a SIGKILL or hard hang at a
+4. **honest-mean deviation of the applied aggregate** — every round runs
+   under the runtime audit monitor (``blades_tpu/audit``), so each round
+   records ``||agg - mean(honest participants)||`` against the honest
+   spread in its ``audit`` telemetry record; the deviation must be finite
+   on every round, and on attack-free rounds with >= 2 honest participants
+   the aggregate must stay within ``DEV_FACTOR`` honest spreads of the
+   honest mean (attack scenarios record the ratio — the breakdown signal
+   the certification matrix quantifies — but only assert finiteness, since
+   the pool deliberately includes breakable defenses like mean);
+5. (supervised scenarios, ``--child`` mode) a SIGKILL or hard hang at a
    random round, followed by the run supervisor's group-kill + relaunch
    with ``BLADES_RESUME=1``, resumes **bit-exactly** against the
    uninterrupted run.
@@ -58,6 +67,16 @@ AGG_POOL = (
 ATTACK_POOL = (None, "signflipping", "ipm", "alie")
 NUM_CLIENTS = 8
 ROUNDS = 3
+# attack-free rounds must keep the aggregate within this many honest
+# spreads of the honest participating mean (invariant 4). Loose by design:
+# it tolerates defenses whose center estimate legitimately sits a few
+# spreads from the arithmetic honest mean, while still catching an
+# aggregate dragged an order of magnitude off the honest set.
+DEV_FACTOR = 8.0
+# exempt from the attack-free bound (deviation still recorded + finite):
+# asyncmean's 1/K damping deviates toward the origin by design whenever
+# clients drop (its documented async semantics, aggregators/decentralized.py)
+DEV_EXEMPT = ("asyncmean",)
 
 
 def make_scenario(seed: int) -> dict:
@@ -168,6 +187,10 @@ def run_scenario(
         global_rounds=scn["rounds"], local_steps=1, train_batch_size=8,
         client_lr=0.2, server_lr=1.0, validate_interval=scn["rounds"],
         fault_model=dict(scn["fault"]),
+        # record-only runtime audit (no fallback): every round's certificate
+        # verdicts + honest-mean deviation land in the telemetry trace for
+        # invariant 4 (blades_tpu/audit, docs/robustness.md)
+        audit_monitor=dict(),
         on_round_end=on_round_end,
         resume=resume,
     )
@@ -223,7 +246,64 @@ def check_invariants(scn: dict, log_path: str, params) -> list:
             # a skip round keeps the previous params; the loss metric is
             # computed from real (pre-fault) training and must stay finite
             violations.append(f"round {r['round']}: non-finite train_loss")
+
+    # invariant 4: per-round honest-mean deviation of the applied aggregate
+    audits = [r for r in recs if r.get("t") == "audit"]
+    if len(audits) != scn["rounds"]:
+        violations.append(
+            f"expected {scn['rounds']} audit records, got {len(audits)}"
+        )
+    for r in audits:
+        dev = r.get("dev_honest")
+        spread = r.get("max_honest_dev")
+        if dev is None or not np.isfinite(dev):
+            violations.append(f"round {r['round']}: non-finite dev_honest")
+            continue
+        if not np.isfinite(spread):
+            violations.append(f"round {r['round']}: non-finite max_honest_dev")
+            continue
+        # the bound applies only to attack-free rounds with a real honest
+        # population and a non-skip aggregate (fltrust's degraded rounds
+        # apply the zero update — agg_norm == 0 — which is an explicit
+        # skip, not a deviation)
+        if (
+            scn["attack"] is None
+            and scn["agg"] not in DEV_EXEMPT
+            and r.get("honest_participants", 0) >= 2
+            and r.get("agg_norm", 0.0) > 0.0
+            and dev > max(DEV_FACTOR * spread, 1e-3)
+        ):
+            violations.append(
+                f"round {r['round']}: attack-free aggregate deviates "
+                f"{dev:.4g} from the honest mean (> {DEV_FACTOR} * spread "
+                f"{spread:.4g})"
+            )
     return violations
+
+
+def max_dev_ratio(log_path: str):
+    """Worst recorded honest-deviation ratio ``dev_honest / spread`` over a
+    scenario's audit records (the per-scenario breakdown signal the sweep
+    summary carries; None when the trace has no audit records). Rounds
+    with < 2 honest participants or ~zero honest spread are skipped — a
+    degenerate denominator says nothing about the defense."""
+    trace = os.path.join(log_path, "telemetry.jsonl")
+    if not os.path.exists(trace):
+        return None
+    ratios = []
+    with open(trace) as f:
+        for line in f:
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if r.get("t") != "audit" or "dev_honest" not in r:
+                continue
+            spread = r.get("max_honest_dev", 0.0)
+            if r.get("honest_participants", 0) < 2 or spread <= 1e-9:
+                continue
+            ratios.append(r["dev_honest"] / spread)
+    return round(max(ratios), 4) if ratios else None
 
 
 # -- sweep (the evidence artifact) --------------------------------------------
@@ -253,6 +333,7 @@ def sweep(n: int, out_dir: str) -> dict:
             "fault": {k: ("schedule" if k == "participation_schedule" else val)
                       for k, val in scn["fault"].items()},
             "loss": round(float(ev["Loss"]), 4),
+            "max_dev_ratio": max_dev_ratio(log),
             "twin_checked": twin is not None,
             "violations": v,
         })

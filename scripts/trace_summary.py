@@ -50,6 +50,7 @@ def summarize(records: List[dict]) -> dict:
     rounds = []
     compiles = []
     defenses = []
+    audits = []
     supervisor: Dict[str, int] = {}
     kill_reasons = []
     meta = {}
@@ -70,6 +71,8 @@ def summarize(records: List[dict]) -> dict:
             compiles.append(r["dur_s"])
         elif t == "defense":
             defenses.append(r)
+        elif t == "audit":
+            audits.append(r)
         elif t == "supervisor":
             ev = r.get("event", "?")
             supervisor[ev] = supervisor.get(ev, 0) + 1
@@ -96,6 +99,29 @@ def summarize(records: List[dict]) -> dict:
         if vals:
             defense_summary[f"mean_{key}"] = sum(vals) / len(vals)
 
+    # runtime-audit rollup (blades_tpu/audit, docs/observability.md):
+    # breach/fallback counts + worst recorded honest-deviation ratio
+    audit_summary: Dict[str, float] = {}
+    if audits:
+        audit_summary["rounds_audited"] = len(audits)
+        audit_summary["breaches"] = sum(r.get("breach", 0) for r in audits)
+        audit_summary["fallback_rounds"] = sum(
+            r.get("fallback_used", 0) for r in audits
+        )
+        # same degenerate-denominator skip as scripts/chaos.py's
+        # max_dev_ratio: < 2 honest participants or ~zero honest spread
+        # says nothing about the defense
+        ratios = [
+            r["dev_honest"] / r["max_honest_dev"]
+            for r in audits
+            if "dev_honest" in r
+            and r.get("honest_participants", 0) >= 2
+            and r.get("max_honest_dev", 0.0) > 1e-9
+        ]
+        if ratios:
+            audit_summary["max_dev_ratio"] = max(ratios)
+            audit_summary["mean_dev_ratio"] = sum(ratios) / len(ratios)
+
     return {
         "meta": meta,
         "spans": spans,
@@ -113,6 +139,7 @@ def summarize(records: List[dict]) -> dict:
             "max_s": max(compiles) if compiles else 0.0,
         },
         "defense": defense_summary,
+        "audit": audit_summary,
         "supervisor": {"events": supervisor, "kill_reasons": kill_reasons},
     }
 
@@ -166,6 +193,13 @@ def format_table(summary: dict) -> str:
             f"{k}={v:.3f}" for k, v in sorted(summary["defense"].items())
         )
         lines.append(f"defense: {pairs}")
+    aud = summary.get("audit") or {}
+    if aud:
+        pairs = ", ".join(
+            f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(aud.items())
+        )
+        lines.append(f"audit: {pairs}")
     sup = summary.get("supervisor") or {}
     if sup.get("events"):
         pairs = ", ".join(f"{k}={v}" for k, v in sorted(sup["events"].items()))
