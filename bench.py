@@ -142,6 +142,12 @@ def child_main() -> None:
     bf16 = os.environ.get("BENCH_BF16", "1") != "0"
     warmup = int(os.environ.get("BENCH_WARMUP", WARMUP))
     timed = int(os.environ.get("BENCH_TIMED", TIMED))
+    # round-block execution: scan BENCH_BLOCK rounds per XLA launch with
+    # the sampler fused into the program (engine.run_block) — deletes the
+    # per-round host floor (sampler launch + dispatch + heartbeat), which
+    # dominates at dispatch-bound configs (small model, small K). 1 =
+    # headline per-round path.
+    block = max(1, int(os.environ.get("BENCH_BLOCK", 1)))
 
     stage = "import"
     try:
@@ -247,10 +253,16 @@ def child_main() -> None:
         key = jax.random.PRNGKey(7)
 
         # materialize the sampler alone first: separates a flaky-backend
-        # compile error from a round-program one in the reported stage
-        stage = "sampler"
-        cx, cy = ds.sample_round(jax.random.fold_in(key, 0), local_steps, batch)
-        jax.block_until_ready(cy)
+        # compile error from a round-program one in the reported stage.
+        # Block mode fuses the sampler into the block program, so there is
+        # no standalone sampler executable to warm (and compiling one would
+        # only pollute the compile counters).
+        if block == 1:
+            stage = "sampler"
+            cx, cy = ds.sample_round(
+                jax.random.fold_in(key, 0), local_steps, batch
+            )
+            jax.block_until_ready(cy)
 
         def one_round(state, r):
             cx, cy = ds.sample_round(
@@ -262,23 +274,54 @@ def child_main() -> None:
             _beat(round_idx=r)
             return state, m
 
+        def one_block(state, r0):
+            keys = jnp.stack(
+                [jax.random.fold_in(key, r) for r in range(r0, r0 + block)]
+            )
+            state, m, _ = engine.run_block(
+                state, keys, [0.1] * block, [1.0] * block, key,
+                sampler=ds.traceable_sampler(local_steps, batch),
+            )
+            _beat(round_idx=r0 + block - 1)
+            return state, m
+
+        # block mode runs whole blocks: round counts snap to multiples of
+        # the block so the fused-vs-unfused comparison times equal work
+        warmup_rounds = max(block, (warmup // block) * block) if block > 1 else warmup
+        timed_rounds = max(block, (timed // block) * block) if block > 1 else timed
+
         stage = "warmup"
-        for r in range(warmup):
-            state, m = one_round(state, r)
+        r = 0
+        while r < warmup_rounds:
+            if block > 1:
+                state, m = one_block(state, r)
+                r += block
+            else:
+                state, m = one_round(state, r)
+                r += 1
         jax.block_until_ready(state.params)
 
         stage = "timed"
         if profile_dir:
             jax.profiler.start_trace(profile_dir)
         t0 = time.time()
-        for r in range(warmup, warmup + timed):
-            state, m = one_round(state, r)
+        launches = 0
+        r = warmup_rounds
+        while r < warmup_rounds + timed_rounds:
+            if block > 1:
+                state, m = one_block(state, r)
+                r += block
+            else:
+                state, m = one_round(state, r)
+                r += 1
+            launches += 1
         jax.block_until_ready(state.params)
         elapsed = time.time() - t0
         if profile_dir:
             jax.profiler.stop_trace()
+        timed = timed_rounds
 
-        loss = float(m.train_loss)
+        loss = float(m.train_loss if block == 1 else m.train_loss[-1])
         if not np.isfinite(loss):
             raise RuntimeError(f"non-finite loss {loss}")
 
@@ -320,23 +363,31 @@ def child_main() -> None:
             "retries": int(counters.get("retry.backend_preflight", 0)),
         }
 
-        # XLA-cost-model FLOPs of the exact compiled round program (the
-        # basis of docs/performance.md's MFU accounting); cost_analysis is
-        # best-effort — some backends/attachment modes don't expose it
+        # XLA-cost-model FLOPs of the exact compiled round (or round-block)
+        # program (the basis of docs/performance.md's MFU accounting);
+        # cost_analysis is best-effort — some backends/attachment modes
+        # don't expose it
         tflop_per_round = None
         try:
-            ca = (
-                engine._round_jit.lower(
-                    state,
-                    cx,
-                    cy,
-                    jnp.asarray(0.1, jnp.float32),
-                    jnp.asarray(1.0, jnp.float32),
-                    key,
+            if block > 1:
+                # the block program's cost model counts the lax.scan BODY
+                # once (trip count is not multiplied in), so per-round
+                # FLOPs must come from the single-round program — lowered
+                # on abstract batch shapes (the block path never
+                # materializes cx/cy)
+                cx, cy = jax.eval_shape(
+                    ds.traceable_sampler(local_steps, batch),
+                    jax.random.fold_in(key, 0),
                 )
-                .compile()
-                .cost_analysis()
+            lowered = engine._round_jit.lower(
+                state,
+                cx,
+                cy,
+                jnp.asarray(0.1, jnp.float32),
+                jnp.asarray(1.0, jnp.float32),
+                key,
             )
+            ca = lowered.compile().cost_analysis()
             if isinstance(ca, (list, tuple)):
                 ca = ca[0]
             flops = float(ca.get("flops", 0.0))
@@ -351,6 +402,12 @@ def child_main() -> None:
                 {
                     "rounds_per_sec": timed / elapsed,
                     "clients": k,
+                    # round-block amortization: rounds per program launch
+                    # and the measured launch rate (launches == rounds when
+                    # block_size == 1)
+                    "block_size": block,
+                    "rounds_per_launch": timed / launches,
+                    "launches": launches,
                     "model": model_name,
                     "agg": agg_name,
                     "agg_kwargs": agg_kwargs,
@@ -417,11 +474,18 @@ def _ladder_main() -> None:
 
     errors = []
     # liveness probe first: when the TPU tunnel is down, backend init hangs
-    # forever — better to burn 240s learning that than the full ladder
-    probe, probe_err = _run_child(
-        {"BENCH_PROBE": 1},
-        float(os.environ.get("BENCH_PROBE_TIMEOUT", 240)),
-    )
+    # forever — better to burn the (BENCH_PROBE_TIMEOUT, default 240 s)
+    # budget learning that than the full ladder. A BLADES_TUNNEL_DOWN=1
+    # hint (set by a harness that already paid for that knowledge, e.g.
+    # tpu_watch.sh or a prior run in the same session) skips the probe
+    # entirely and drops straight to the labeled cpu_k8 fallback.
+    if os.environ.get("BLADES_TUNNEL_DOWN") == "1":
+        probe, probe_err = None, "skipped (BLADES_TUNNEL_DOWN=1 hint)"
+    else:
+        probe, probe_err = _run_child(
+            {"BENCH_PROBE": 1},
+            float(os.environ.get("BENCH_PROBE_TIMEOUT", 240)),
+        )
     on_accelerator = probe is not None and probe.get("platform") not in (
         None, "cpu"
     )
@@ -441,8 +505,11 @@ def _ladder_main() -> None:
         # timeout without proving anything more
         ladder = [
             (
+                # BENCH_BLOCK pinned to 1: block-mode round snapping would
+                # inflate the pinned 1+2 rounds to a full block each and
+                # blow the smoke timeout this config is sized for
                 {"BENCH_CLIENTS": 8, "BENCH_CHUNKS": 1, "BENCH_BATCH": 8,
-                 "BENCH_BF16": 0, "BENCH_FORCE_CPU": 1,
+                 "BENCH_BF16": 0, "BENCH_FORCE_CPU": 1, "BENCH_BLOCK": 1,
                  "BENCH_WARMUP": 1, "BENCH_TIMED": 2},
                 smoke_timeout,
                 "cpu-smoke",
@@ -521,6 +588,11 @@ def _ladder_main() -> None:
         "unit": "rounds/sec",
         "vs_baseline": round(rps / baseline_rps, 2) if baseline_rps else None,
     }
+    # round-block amortization fields ride on every payload (block_size 1 =
+    # the per-round headline path; launches == rounds there)
+    if result.get("block_size") is not None:
+        payload["block_size"] = result["block_size"]
+        payload["rounds_per_launch"] = result.get("rounds_per_launch")
     nondefault_model = result.get("model", "cct_2_3x2_32") != "cct_2_3x2_32"
     nondefault_agg = result.get("agg", "trimmedmean") != "trimmedmean"
     # any attacked / Adam-client / multi-step variant is not the headline
@@ -530,6 +602,8 @@ def _ladder_main() -> None:
         or result.get("num_byz", 0)
         or result.get("client_opt", "sgd") != "sgd"
         or result.get("local_steps", 1) != 1
+        # block-amortized timing is not the per-round headline cadence
+        or result.get("block_size", 1) != 1
     )
     if (
         result["clients"] != full_k
@@ -554,6 +628,8 @@ def _ladder_main() -> None:
                 f"_{result.get('client_opt', 'sgd')}"
                 f"_ls{result.get('local_steps', 1)}"
             )
+            if result.get("block_size", 1) != 1:
+                payload["config"] += f"_blk{result['block_size']}"
             payload["vs_baseline"] = None
     if errors:
         payload["attempt_errors"] = "; ".join(errors)[:500]

@@ -18,6 +18,14 @@ One call to :meth:`RoundEngine.run_round` executes, as a single XLA program:
 Learning rates enter as traced scalars so per-round schedules never trigger
 recompilation. Optimizers are lr-free optax transforms; the engine applies
 ``params += -lr * transformed_grads`` itself (torch-SGD/Adam semantics).
+
+Round-block execution (:meth:`RoundEngine.run_block`) goes one step
+further: the dataset's sampler is fused INTO the program and ``lax.scan``
+runs ``block_size`` rounds per XLA launch — the per-round host floor
+(sampler launch, dispatch, blocking metrics fetch) is paid once per block,
+and an R-round block is bit-identical to R sequential rounds (the FedJAX
+federated-scan design, Ro et al., 2021; the reference re-enters Python and
+the Ray object store every round).
 """
 
 from __future__ import annotations
@@ -124,7 +132,13 @@ class RoundMetrics(NamedTuple):
 
 
 class RoundEngine:
-    """Builds and caches the jitted round / eval programs.
+    """Builds and caches the jitted round / round-block / eval programs.
+
+    :meth:`run_round` executes one federated round as one XLA program;
+    :meth:`run_block` scans the same round body over R rounds per launch
+    (sampler fused in-graph, see module docstring); :meth:`warm_eval`
+    eagerly builds the eval executable so its cold compile never lands
+    mid-run.
 
     Parameters
     ----------
@@ -247,6 +261,11 @@ class RoundEngine:
         self._round_jit = jax.jit(self._round, donate_argnums=donate)
         self._eval_jit = jax.jit(self._eval_batch)
         self._eval_per_sample_jit = jax.jit(self._eval_batch_per_sample)
+        # round-block execution (run_block): one jitted scan program per
+        # installed sampler; distinct block lengths R are separate traces of
+        # the same jit object (at most 2 per run: full blocks + remainder)
+        self._block_jit = None
+        self._block_sampler = None
 
     # -- state ---------------------------------------------------------------
 
@@ -594,6 +613,94 @@ class RoundEngine:
         )
         return new_state, metrics
 
+    # -- round-block execution -----------------------------------------------
+
+    def _build_block(self, sampler: Callable) -> Callable:
+        """One jitted program scanning the full round body — in-graph batch
+        sampling included — over a block of rounds. The per-round ``[K, D]``
+        update matrix stays internal to each scan step (never a program
+        output), so a block's HBM footprint equals a single round's."""
+
+        def block(state, sample_keys, client_lrs, server_lrs, key):
+            def body(st, per_round):
+                skey, c_lr, s_lr = per_round
+                cx, cy = sampler(skey)
+                new_st, metrics, _updates, agg_diag, fault_diag, audit_diag = (
+                    self._round(st, cx, cy, c_lr, s_lr, key)
+                )
+                return new_st, (metrics, agg_diag, fault_diag, audit_diag)
+
+            final, ys = lax.scan(
+                body, state, (sample_keys, client_lrs, server_lrs)
+            )
+            return final, ys
+
+        return jax.jit(block, donate_argnums=(0,))
+
+    def run_block(
+        self,
+        state: RoundState,
+        sample_keys: jnp.ndarray,
+        client_lrs: jnp.ndarray,
+        server_lrs: jnp.ndarray,
+        key: jax.Array,
+        sampler: Callable = None,
+    ):
+        """Execute ``R = len(sample_keys)`` federated rounds as ONE XLA
+        program: ``lax.scan`` over the exact per-round body ``run_round``
+        traces, with the dataset's sampler fused in (``sampler`` is the
+        traceable ``key -> (cx, cy)`` function, e.g.
+        ``FLDataset.traceable_sampler``) — no per-round program launch, no
+        host round-trip, one device->host transfer for the whole block.
+        The federated-rounds-in-one-scan design follows FedJAX (Ro et al.,
+        2021); the reference's loop re-enters Python and the Ray object
+        store every round (``src/blades/simulator.py:203-245``).
+
+        ``sample_keys``: stacked ``[R]`` per-round sampling keys (the same
+        keys the caller would have passed to ``sample_round``).
+        ``client_lrs``/``server_lrs``: ``[R]`` float32 schedules.
+
+        Returns ``(new_state, metrics, diags)``: stacked ``[R]``-leading
+        :class:`RoundMetrics`, and a dict with the stacked per-round
+        ``defense`` / ``faults`` / ``audit`` diagnostics (``None`` for
+        surfaces not installed). Bit-exactness contract: an R-round block
+        equals R sequential :meth:`run_round` calls bit-for-bit
+        (``tests/test_engine.py``), so blocks are a pure scheduling choice.
+        ``last_updates`` is ``None`` after a block (the matrix is consumed
+        in-graph); ``last_diagnostics``/``last_fault_diag``/
+        ``last_audit_diag`` hold the block's FINAL round."""
+        if sampler is None:
+            raise ValueError("run_block needs the dataset's traceable sampler")
+        if self._block_jit is None or self._block_sampler is not sampler:
+            self._block_jit = self._build_block(sampler)
+            self._block_sampler = sampler
+        r = int(sample_keys.shape[0])
+        with get_recorder().span("dispatch", rounds=r):
+            new_state, (metrics, agg_diag, fault_diag, audit_diag) = (
+                self._block_jit(
+                    state,
+                    sample_keys,
+                    jnp.asarray(client_lrs, jnp.float32),
+                    jnp.asarray(server_lrs, jnp.float32),
+                    key,
+                )
+            )
+        last = lambda tree: jax.tree_util.tree_map(lambda a: a[-1], tree)
+        self.last_updates = None
+        self.last_diagnostics = last(agg_diag) if self.collect_diagnostics else None
+        self.last_fault_diag = (
+            last(fault_diag) if self.fault_model is not None else None
+        )
+        self.last_audit_diag = (
+            last(audit_diag) if self.audit_monitor is not None else None
+        )
+        diags = {
+            "defense": agg_diag if self.collect_diagnostics else None,
+            "faults": fault_diag if self.fault_model is not None else None,
+            "audit": audit_diag if self.audit_monitor is not None else None,
+        }
+        return new_state, metrics, diags
+
     # -- evaluation ----------------------------------------------------------
 
     def _eval_batch_per_sample(self, params, x, y):
@@ -608,6 +715,19 @@ class RoundEngine:
         losses, correct = self._eval_batch_per_sample(params, x, y)
         m = mask.astype(jnp.float32)
         return (losses * m).sum(), (correct * m).sum(), m.sum()
+
+    def warm_eval(
+        self, params: Any, x: jnp.ndarray, y: jnp.ndarray, batch_size: int = 512
+    ) -> None:
+        """Eagerly build the per-sample eval executable for the exact padded
+        batch shape ``evaluate``/``evaluate_per_sample`` will use (one
+        zeros-batch execution — negligible next to the compile it fronts).
+        Without this, the eval program's first cold build lands mid-run at
+        the first validate round: the classic between-heartbeat gap under
+        supervision, and a stall in the middle of a round block."""
+        xb = jnp.zeros((batch_size,) + tuple(x.shape[1:]), x.dtype)
+        yb = jnp.zeros((batch_size,), y.dtype)
+        jax.block_until_ready(self._eval_per_sample_jit(params, xb, yb))
 
     def evaluate(
         self, state: RoundState, x: jnp.ndarray, y: jnp.ndarray, batch_size: int = 512

@@ -12,6 +12,12 @@ without-replacement epochs with reshuffle-on-wraparound
 uniform-argsort trick: draw a fresh without-replacement permutation of each
 client's samples each round and index it modulo the client's sample count
 (wraparound). Every round is a pure function of (seed, round).
+
+The sampler itself is a pure traceable closure: ``sample_round`` runs it as
+its own jitted program, while ``traceable_sampler`` hands the bare function
+to the round-block engine (``core/engine.py``), which fuses it INSIDE the
+scanned round program — a block of R rounds samples and trains in one XLA
+launch with no per-round sampler dispatch.
 """
 
 from __future__ import annotations
@@ -93,6 +99,7 @@ class FLDataset:
             [[0], np.cumsum(self.test_counts)[:-1]]
         ).astype(np.int64)
         self._sample_jit: Dict[Tuple[int, int], Callable] = {}
+        self._traceable: Dict[Tuple[int, int], Callable] = {}
         self._sharding = None  # set by place(); constrains sampler outputs
         # per-client host-side epoch streams for get_train_data (reference
         # infinite-generator semantics, ``basedataset.py:58-86``)
@@ -125,6 +132,7 @@ class FLDataset:
         self.train_x, self.train_y, self.train_counts = tx, ty, tc
         self._sharding = clients_sharding
         self._sample_jit.clear()  # re-trace with the new output layout
+        self._traceable.clear()
         return self
 
     # -- reference-API parity -------------------------------------------------
@@ -140,11 +148,16 @@ class FLDataset:
 
     # -- round sampling -------------------------------------------------------
 
-    def _build_sampler(self, local_steps: int, batch_size: int) -> Callable:
+    def _make_sample_fn(self, local_steps: int, batch_size: int) -> Callable:
+        """The pure ``key -> (cx, cy)`` sampling function: traceable, so it
+        can run either as its own jitted program (:meth:`sample_round`) or
+        fused INSIDE a larger one (the engine's round block,
+        ``core/engine.py:RoundEngine.run_block`` — no separate sampler
+        launch per round). The data store is captured by closure at trace
+        time, so :meth:`place` invalidates both caches."""
         n_max = int(self.train_x.shape[1])
         need = local_steps * batch_size
 
-        @jax.jit
         def sample(key: jax.Array):
             ku, kt = jax.random.split(key)
             # fresh without-replacement order per client; padding pushed to the
@@ -181,13 +194,27 @@ class FLDataset:
 
         return sample
 
+    def traceable_sampler(self, local_steps: int, batch_size: int) -> Callable:
+        """The pure sampling function itself (``key -> (cx, cy)``), for
+        callers that trace it into their own jitted program — the round-block
+        engine calls it inside ``lax.scan`` so a block of R rounds samples
+        and trains in ONE XLA launch. Cached per ``(local_steps,
+        batch_size)`` so the returned object is stable (jit-cache friendly);
+        :meth:`place` invalidates."""
+        sig = (local_steps, batch_size)
+        if sig not in self._traceable:
+            self._traceable[sig] = self._make_sample_fn(local_steps, batch_size)
+        return self._traceable[sig]
+
     def sample_round(
         self, key: jax.Array, local_steps: int, batch_size: int
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """``[K, S, B, ...]`` train batches for every client, in one gather."""
         sig = (local_steps, batch_size)
         if sig not in self._sample_jit:
-            self._sample_jit[sig] = self._build_sampler(local_steps, batch_size)
+            self._sample_jit[sig] = jax.jit(
+                self._make_sample_fn(local_steps, batch_size)
+            )
         return self._sample_jit[sig](key)
 
     def get_train_data(

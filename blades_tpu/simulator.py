@@ -314,6 +314,7 @@ class Simulator:
         collect_diagnostics: Optional[bool] = None,
         fault_model: Optional[Union[FaultModel, Dict]] = None,
         audit_monitor: Optional[Union[AuditMonitor, Dict]] = None,
+        block_size: int = 1,
     ) -> List[float]:
         """Run adversarial training; returns per-round wall times (reference
         ``run`` contract, ``simulator.py:364-457``).
@@ -356,6 +357,22 @@ class Simulator:
         trace as ``audit`` records (``docs/observability.md``); breach ->
         fallback rounds are bit-reproducible under a fixed seed, including
         across kill/resume.
+        ``block_size``: execute rounds in blocks of this many per XLA
+        launch (``RoundEngine.run_block``): the dataset's sampler is fused
+        into the round program and ``lax.scan`` carries the full round
+        state across the block, so the per-round host floor (sampler
+        launch, dispatch, blocking metrics fetch, telemetry flush,
+        heartbeat) is paid once per block. An R-round block is bit-exact
+        against R sequential rounds (tested), so this is a pure scheduling
+        choice — but eval / checkpoint / telemetry flush / heartbeat move
+        to block boundaries (per-round ``train``/``variance``/telemetry
+        records are still emitted, unstacked from the block's ``[R]``
+        outputs), and autosave/checkpoint states land on block boundaries
+        (resume stays bit-exact; a remainder block handles
+        ``rounds % block_size``, so at most 2 block programs compile).
+        Falls back to per-round execution (with a debug note) when
+        ``retain_updates``/``on_round_end`` need per-round host visibility
+        or the dataset has no traceable sampler.
 
         Telemetry (``docs/observability.md``): unless ``BLADES_TELEMETRY=0``,
         a span/counter trace of the run is appended to
@@ -525,6 +542,27 @@ class Simulator:
         client_lr_fn = self._resolve_schedule(client_lr_scheduler, client_lr)
         server_lr_fn = self._resolve_schedule(server_lr_scheduler, server_lr)
 
+        # round-block scheduling: fuse the sampler into the round program and
+        # scan block_size rounds per XLA launch (RoundEngine.run_block)
+        block_size = max(1, int(block_size))
+        sampler = None
+        if block_size > 1 and (retain_updates or on_round_end is not None):
+            self.debug_logger.info(
+                "block_size>1 disabled: retain_updates/on_round_end need "
+                "per-round host visibility"
+            )
+            block_size = 1
+        if block_size > 1:
+            if hasattr(self.dataset, "traceable_sampler"):
+                sampler = self.dataset.traceable_sampler(
+                    local_steps, batch_size
+                )
+            else:
+                self.debug_logger.info(
+                    "block_size>1 disabled: dataset has no traceable_sampler"
+                )
+                block_size = 1
+
         data_key = jax.random.fold_in(key, 23)
         round_times: List[float] = []
         global_start = time.time()
@@ -533,81 +571,118 @@ class Simulator:
         prof_first = min(max(start_round, 2), global_rounds)
         prof_last = min(prof_first + 2, global_rounds)
         trace_active = False
+        # eagerly build the eval executable so its first cold compile never
+        # lands mid-run (the classic between-heartbeat gap under
+        # supervision, and a mid-block stall under round-block scheduling);
+        # skipped when this run will never evaluate
+        if (global_rounds // validate_interval) * validate_interval >= start_round:
+            with rec.span("eval_warmup"):
+                self.engine.warm_eval(
+                    state.params,
+                    self.dataset.test_x,
+                    self.dataset.test_y,
+                    batch_size=test_batch_size,
+                )
         try:
-            for rnd in range(start_round, global_rounds + 1):
-                if profile_dir and rnd == prof_first:
-                    jax.profiler.start_trace(profile_dir)
-                    trace_active = True
-                round_start = time.time()
-                with rec.span("round"):
-                    with rec.span("sample"):
-                        cx, cy = self.dataset.sample_round(
-                            jax.random.fold_in(data_key, rnd), local_steps,
-                            batch_size,
-                        )
-                    c_lr = client_lr_fn(rnd - 1)
-                    s_lr = server_lr_fn(rnd - 1)
-                    # emits the nested round/dispatch span
-                    state, m = self.engine.run_round(state, cx, cy, c_lr, s_lr, key)
-                    self.server.state = state
-
-                    with rec.span("sync"):
-                        # device execution of the async round program lands
-                        # here (log_train's float() conversions used to
-                        # absorb it)
-                        jax.block_until_ready(m)
-                    self.log_train(rnd, local_steps, m)
-                    self.log_variance(rnd, m)
-                    self._log_defense(rnd)
-                    self._log_faults(rnd)
-                    self._log_audit(rnd)
-                    if retain_updates:
-                        # populate reference-parity client.get_update() views
-                        for i, c in enumerate(self.get_clients()):
-                            c.save_update(self.engine.last_updates[i])
-                    if on_round_end is not None:
-                        # observability hook: (round, state, metrics); the
-                        # round's post-attack update matrix is
-                        # engine.last_updates
-                        on_round_end(rnd, state, m)
-
-                    if rnd % validate_interval == 0:
-                        with rec.span("eval"):
-                            ev = self.evaluate(rnd, test_batch_size)
-                        self.debug_logger.info(
-                            f"Test global round {rnd}, loss: {ev['Loss']}, "
-                            f"top1: {ev['top1']}"
-                        )
-
-                    if trace_active and rnd == prof_last:
-                        jax.block_until_ready(state.params)
-                        jax.profiler.stop_trace()
-                        trace_active = False
-                    if (
-                        checkpoint_path
-                        and checkpoint_interval
-                        and rnd % checkpoint_interval == 0
-                    ):
-                        with rec.span("checkpoint"):
-                            save_state(checkpoint_path, state)
-
-                wall = time.time() - round_start
-                round_times.append(wall)
-                # per-round summary + the round's single buffered trace write
-                rec.round_record(
-                    rnd,
-                    wall_s=wall,
-                    train_loss=float(m.train_loss),
-                    train_top1=float(m.train_top1),
+            if block_size > 1:
+                self._run_blocks(
+                    state=state,
+                    rec=rec,
+                    sampler=sampler,
+                    block_size=block_size,
+                    start_round=start_round,
+                    global_rounds=global_rounds,
+                    local_steps=local_steps,
+                    validate_interval=validate_interval,
+                    test_batch_size=test_batch_size,
+                    checkpoint_path=checkpoint_path,
+                    checkpoint_interval=checkpoint_interval,
+                    client_lr_fn=client_lr_fn,
+                    server_lr_fn=server_lr_fn,
+                    data_key=data_key,
+                    key=key,
+                    round_times=round_times,
+                    global_start=global_start,
+                    profile_dir=profile_dir,
+                    prof_first=prof_first,
+                    prof_last=prof_last,
                 )
-                rec.flush()
-                # supervised runs: liveness beat piggybacked on the round
-                # flush (no-op when BLADES_HEARTBEAT_FILE is unset)
-                _heartbeat.beat(round_idx=rnd)
-                self.debug_logger.info(
-                    f"E={rnd}; Client learning rate = {c_lr}; "
-                    f"Time cost = {time.time() - global_start}"
-                )
+                state = self.server.state
+            else:
+                for rnd in range(start_round, global_rounds + 1):
+                    if profile_dir and rnd == prof_first:
+                        jax.profiler.start_trace(profile_dir)
+                        trace_active = True
+                    round_start = time.time()
+                    with rec.span("round"):
+                        with rec.span("sample"):
+                            cx, cy = self.dataset.sample_round(
+                                jax.random.fold_in(data_key, rnd), local_steps,
+                                batch_size,
+                            )
+                        c_lr = client_lr_fn(rnd - 1)
+                        s_lr = server_lr_fn(rnd - 1)
+                        # emits the nested round/dispatch span
+                        state, m = self.engine.run_round(state, cx, cy, c_lr, s_lr, key)
+                        self.server.state = state
+
+                        with rec.span("sync"):
+                            # device execution of the async round program lands
+                            # here (log_train's float() conversions used to
+                            # absorb it)
+                            jax.block_until_ready(m)
+                        self.log_train(rnd, local_steps, m)
+                        self.log_variance(rnd, m)
+                        self._log_defense(rnd)
+                        self._log_faults(rnd)
+                        self._log_audit(rnd)
+                        if retain_updates:
+                            # populate reference-parity client.get_update() views
+                            for i, c in enumerate(self.get_clients()):
+                                c.save_update(self.engine.last_updates[i])
+                        if on_round_end is not None:
+                            # observability hook: (round, state, metrics); the
+                            # round's post-attack update matrix is
+                            # engine.last_updates
+                            on_round_end(rnd, state, m)
+
+                        if rnd % validate_interval == 0:
+                            with rec.span("eval"):
+                                ev = self.evaluate(rnd, test_batch_size)
+                            self.debug_logger.info(
+                                f"Test global round {rnd}, loss: {ev['Loss']}, "
+                                f"top1: {ev['top1']}"
+                            )
+
+                        if trace_active and rnd == prof_last:
+                            jax.block_until_ready(state.params)
+                            jax.profiler.stop_trace()
+                            trace_active = False
+                        if (
+                            checkpoint_path
+                            and checkpoint_interval
+                            and rnd % checkpoint_interval == 0
+                        ):
+                            with rec.span("checkpoint"):
+                                save_state(checkpoint_path, state)
+
+                    wall = time.time() - round_start
+                    round_times.append(wall)
+                    # per-round summary + the round's single buffered trace write
+                    rec.round_record(
+                        rnd,
+                        wall_s=wall,
+                        train_loss=float(m.train_loss),
+                        train_top1=float(m.train_top1),
+                    )
+                    rec.flush()
+                    # supervised runs: liveness beat piggybacked on the round
+                    # flush (no-op when BLADES_HEARTBEAT_FILE is unset)
+                    _heartbeat.beat(round_idx=rnd)
+                    self.debug_logger.info(
+                        f"E={rnd}; Client learning rate = {c_lr}; "
+                        f"Time cost = {time.time() - global_start}"
+                    )
             # the run completed: a leftover CRASH autosave (implicit path
             # only — never a user-configured checkpoint) is now stale, and
             # a later resume=True must not silently re-train from it
@@ -622,18 +697,20 @@ class Simulator:
                 except OSError:
                     pass
         except BaseException as e:  # noqa: BLE001 - incl. KeyboardInterrupt
-            # auto-checkpoint on ANY mid-run failure: `state` is the last
-            # fully completed round's state (the assignment happens only
-            # after run_round returns), so the save is always consistent.
-            # Best-effort — a poisoned device buffer must not mask the
-            # original exception with a save error.
+            # auto-checkpoint on ANY mid-run failure: `self.server.state` is
+            # the last fully completed round's (or block's) state — both
+            # loops assign it only after the round/block program returns —
+            # so the save is always consistent. Best-effort — a poisoned
+            # device buffer must not mask the original exception with a
+            # save error.
+            crash_state = self.server.state
             try:
                 with rec.span("crash_checkpoint"):
-                    save_state(autosave_path, state)
+                    save_state(autosave_path, crash_state)
                 rec.event(
                     "crash_checkpoint",
                     path=checkpoint_file(autosave_path),
-                    round=int(state.round_idx),
+                    round=int(crash_state.round_idx),
                     error=f"{type(e).__name__}: {e}"[:300],
                 )
                 self.debug_logger.info(
@@ -658,6 +735,122 @@ class Simulator:
                 except (ValueError, OSError):
                     pass
         return round_times
+
+    def _run_blocks(
+        self,
+        *,
+        state,
+        rec,
+        sampler,
+        block_size,
+        start_round,
+        global_rounds,
+        local_steps,
+        validate_interval,
+        test_batch_size,
+        checkpoint_path,
+        checkpoint_interval,
+        client_lr_fn,
+        server_lr_fn,
+        data_key,
+        key,
+        round_times,
+        global_start,
+        profile_dir,
+        prof_first,
+        prof_last,
+    ) -> None:
+        """Round-block scheduling: execute ``[start_round, global_rounds]``
+        in blocks of ``block_size`` rounds per XLA launch
+        (``RoundEngine.run_block``), a remainder block absorbing
+        ``rounds % block_size`` — at most 2 compiled block programs per
+        run. Per-round ``train``/``variance``/``defense``/``faults``/
+        ``audit`` records are unstacked from the block's ``[R]`` outputs
+        (schema unchanged); eval, checkpoint, the telemetry flush, and the
+        supervision heartbeat run once per block, at the boundary — so
+        checkpoints/autosaves always hold block-boundary states and resume
+        stays bit-exact. Appends per-round amortized wall times to
+        ``round_times`` and leaves the final state on ``self.server``."""
+        trace_active = False
+
+        def slice_round(tree, i):
+            return jax.tree_util.tree_map(lambda a: a[i], tree)
+
+        rnd = start_round
+        while rnd <= global_rounds:
+            bs = min(block_size, global_rounds - rnd + 1)
+            rounds = range(rnd, rnd + bs)
+            if profile_dir and not trace_active and rnd <= prof_first < rnd + bs:
+                jax.profiler.start_trace(profile_dir)
+                trace_active = True
+            block_start = time.time()
+            with rec.span("block", rounds=bs):
+                sample_keys = jnp.stack(
+                    [jax.random.fold_in(data_key, r) for r in rounds]
+                )
+                c_lrs = [client_lr_fn(r - 1) for r in rounds]
+                s_lrs = [server_lr_fn(r - 1) for r in rounds]
+                # emits the nested block/dispatch span
+                state, ms, diags = self.engine.run_block(
+                    state, sample_keys, c_lrs, s_lrs, key, sampler=sampler
+                )
+                self.server.state = state
+                with rec.span("sync"):
+                    # device execution of the whole async block lands here
+                    jax.block_until_ready(ms)
+                for i, r in enumerate(rounds):
+                    mi = slice_round(ms, i)
+                    self.log_train(r, local_steps, mi)
+                    self.log_variance(r, mi)
+                    if diags["defense"] is not None:
+                        self._log_defense(r, diag=slice_round(diags["defense"], i))
+                    if diags["faults"] is not None:
+                        self._log_faults(r, diag=slice_round(diags["faults"], i))
+                    if diags["audit"] is not None:
+                        self._log_audit(r, diag=slice_round(diags["audit"], i))
+
+                if any(r % validate_interval == 0 for r in rounds):
+                    with rec.span("eval"):
+                        ev = self.evaluate(rounds[-1], test_batch_size)
+                    self.debug_logger.info(
+                        f"Test global round {rounds[-1]}, loss: {ev['Loss']}, "
+                        f"top1: {ev['top1']}"
+                    )
+
+                if trace_active and rounds[-1] >= prof_last:
+                    jax.block_until_ready(state.params)
+                    jax.profiler.stop_trace()
+                    trace_active = False
+                if (
+                    checkpoint_path
+                    and checkpoint_interval
+                    and any(r % checkpoint_interval == 0 for r in rounds)
+                ):
+                    with rec.span("checkpoint"):
+                        save_state(checkpoint_path, state)
+
+            wall = time.time() - block_start
+            for i, r in enumerate(rounds):
+                round_times.append(wall / bs)
+                # per-round summaries (amortized wall), ONE buffered trace
+                # write per block
+                rec.round_record(
+                    r,
+                    wall_s=wall / bs,
+                    train_loss=float(ms.train_loss[i]),
+                    train_top1=float(ms.train_top1[i]),
+                )
+            rec.flush()
+            # supervised runs: one liveness beat per block boundary — size
+            # the supervisor's --heartbeat-timeout to cover a whole block
+            # plus its compile (docs/robustness.md)
+            _heartbeat.beat(round_idx=rounds[-1])
+            self.debug_logger.info(
+                f"E={rounds[0]}-{rounds[-1]}; block={bs}; "
+                f"Client learning rate = {c_lrs[-1]}; "
+                f"Time cost = {time.time() - global_start}"
+            )
+            rnd += bs
 
     def _model_spec(self, model, loss, compute_dtype=None) -> ModelSpec:
         if isinstance(model, ModelSpec):
@@ -727,15 +920,17 @@ class Simulator:
         }
         self.json_logger.info(r)
 
-    def _log_defense(self, rnd: int) -> None:
+    def _log_defense(self, rnd: int, diag=None) -> None:
         """Aggregator forensics -> one ``defense`` telemetry record per
         round: the raw diagnostics pytree plus byz-overlap summaries — how
         much of what the defense selected/trimmed/clipped/trusted was
         actually byzantine (ground truth the simulator knows but a real
-        deployment would not). No reference counterpart: the reference
+        deployment would not). ``diag`` overrides the engine's last-round
+        pytree (the block loop passes each round's slice of the stacked
+        ``[R]`` diagnostics). No reference counterpart: the reference
         records nothing about defense decisions (``simulator.py:244`` just
         applies the aggregate)."""
-        diag = self.engine.last_diagnostics
+        diag = self.engine.last_diagnostics if diag is None else diag
         if not diag or not self.telemetry.enabled:
             return
         byz = np.asarray(self.engine.byz_mask)
@@ -771,14 +966,16 @@ class Simulator:
             "defense", round=rnd, agg=repr(self.aggregator), **fields, **overlap
         )
 
-    def _log_faults(self, rnd: int) -> None:
+    def _log_faults(self, rnd: int, diag=None) -> None:
         """Fault-injection forensics -> one ``faults`` telemetry record per
         round: participants, dropouts, stale replays, expired stragglers,
         corrupted payloads, and non-finite exclusions (``blades_tpu.faults``
-        diagnostics). The counts also land as gauges so every ``round``
+        diagnostics; ``diag`` = one round's slice under round-block
+        scheduling). The counts also land as gauges so every ``round``
         record carries the latest values. Reference counterpart: none — the
         reference has no system-fault surface."""
-        diag = getattr(self.engine, "last_fault_diag", None)
+        if diag is None:
+            diag = getattr(self.engine, "last_fault_diag", None)
         if not diag or not self.telemetry.enabled:
             return
         fields = {name: int(np.asarray(v)) for name, v in diag.items()}
@@ -786,16 +983,18 @@ class Simulator:
             self.telemetry.gauge(f"faults.{name}", value)
         self.telemetry.event("faults", round=rnd, **fields)
 
-    def _log_audit(self, rnd: int) -> None:
+    def _log_audit(self, rnd: int, diag=None) -> None:
         """Runtime-audit forensics -> one ``audit`` telemetry record per
         round: certificate verdicts (median-ball, envelope), breach /
         fallback flags, and the oracle honest-deviation fields (the two
         sides of the (f, c)-resilience bound — ground truth the simulator
-        knows but a real deployment would not). The headline flags also
-        land as gauges so every ``round`` record carries the latest values.
+        knows but a real deployment would not; ``diag`` = one round's slice
+        under round-block scheduling). The headline flags also land as
+        gauges so every ``round`` record carries the latest values.
         Reference counterpart: none (``src/blades/simulator.py:244``
         applies whatever the aggregator returns, unaudited)."""
-        diag = getattr(self.engine, "last_audit_diag", None)
+        if diag is None:
+            diag = getattr(self.engine, "last_audit_diag", None)
         if not diag or not self.telemetry.enabled:
             return
         fields = {}

@@ -77,6 +77,38 @@ def child_row(name, timeout=2400, **env):
 def main():
     if os.path.exists(ROWS):
         os.unlink(ROWS)
+    # config 1 dispatch-bound pair: MLP at K=100 with the mean aggregator
+    # is the config where the per-round host floor (sampler launch +
+    # program dispatch, serialized with device work on a 1-core host)
+    # rivals device time — the round-block fusion target. Same workload
+    # twice: per-round launches (block 1) vs 64 rounds per XLA launch
+    # (BENCH_BLOCK=64, sampler fused into the scanned round program); the
+    # fused/unfused ratio row quantifies the deleted overhead (measured
+    # 2.7x on this host; committed pair in results/round_block/).
+    dispatch_env = dict(
+        BENCH_MODEL="mlp", BENCH_CLIENTS=100, BENCH_CHUNKS=1,
+        BENCH_BATCH=4, BENCH_AGG="mean",
+    )
+    r1 = child_row("config1_mlp_k100_dispatch_block1",
+                   BENCH_BLOCK=1, BENCH_WARMUP=8, BENCH_TIMED=64,
+                   **dispatch_env)
+    r64 = child_row("config1_mlp_k100_dispatch_block64",
+                    BENCH_BLOCK=64, BENCH_WARMUP=64, BENCH_TIMED=128,
+                    **dispatch_env)
+    if "rounds_per_sec" in r1 and "rounds_per_sec" in r64:
+        ratio = {
+            "name": "config1_mlp_k100_fused_vs_unfused",
+            "block1_rps": r1["rounds_per_sec"],
+            "block64_rps": r64["rounds_per_sec"],
+            "fused_speedup": round(
+                r64["rounds_per_sec"] / r1["rounds_per_sec"], 3
+            ),
+            "date": datetime.datetime.utcnow().isoformat(),
+        }
+        with open(ROWS, "a") as f:
+            f.write(json.dumps(ratio) + "\n")
+        print(f"[baseline_cpu] fused_vs_unfused -> {ratio['fused_speedup']}x",
+              flush=True)
     # config 2: ResNet-18 fedsgd, no attack + mean (BASELINE row: K=100)
     child_row("config2_resnet18_fedsgd_mean_cpuK4",
               BENCH_MODEL="resnet18", BENCH_CLIENTS=4, BENCH_CHUNKS=1,

@@ -30,7 +30,12 @@ per scenario (``check_invariants``):
 5. (supervised scenarios, ``--child`` mode) a SIGKILL or hard hang at a
    random round, followed by the run supervisor's group-kill + relaunch
    with ``BLADES_RESUME=1``, resumes **bit-exactly** against the
-   uninterrupted run.
+   uninterrupted run;
+6. **round-block neutrality** — every 8th scenario reruns through
+   ``Simulator.run(block_size=2)`` (the ``lax.scan`` round-block program
+   with the sampler fused in) and must produce bit-identical final
+   parameters: block scheduling composes with fault weather and the audit
+   monitor without moving the model.
 
 Usage::
 
@@ -176,8 +181,12 @@ def run_scenario(
     on_round_end=None,
     checkpoint: bool = False,
     resume: bool = False,
+    block_size: int = 1,
 ):
-    """Execute one scenario; returns ``(sim, flat_final_params)``."""
+    """Execute one scenario; returns ``(sim, flat_final_params)``.
+    ``block_size > 1`` schedules the same rounds through the round-block
+    path (``Simulator.run(block_size=...)``) — used by the sweep's block
+    slice to pin fault/audit/resume composition under ``lax.scan``."""
     import numpy as np
 
     from blades_tpu.ops.pytree import ravel
@@ -193,6 +202,7 @@ def run_scenario(
         audit_monitor=dict(),
         on_round_end=on_round_end,
         resume=resume,
+        block_size=block_size,
     )
     if checkpoint:
         kw.update(
@@ -328,6 +338,19 @@ def sweep(n: int, out_dir: str) -> dict:
             _, params2 = run_scenario(twin, os.path.join(out_dir, f"s{seed:03d}_twin"))
             if not np.array_equal(params, params2):
                 v.append("nan<->inf content swap changed final params")
+        # round-block slice: every 8th scenario reruns through
+        # Simulator.run(block_size=2) — the scanned round program with the
+        # sampler fused in, composed with this scenario's fault weather and
+        # the record-only audit — and must land on bit-identical params
+        # (blocks are a pure scheduling choice; 3 rounds at block 2 also
+        # exercises the remainder block)
+        block_checked = seed % 8 == 2
+        if block_checked:
+            _, params_blk = run_scenario(
+                scn, os.path.join(out_dir, f"s{seed:03d}_blk"), block_size=2
+            )
+            if not np.array_equal(params, params_blk):
+                v.append("block_size=2 changed final params")
         results.append({
             "seed": seed, "agg": scn["agg"], "attack": scn["attack"],
             "fault": {k: ("schedule" if k == "participation_schedule" else val)
@@ -335,6 +358,7 @@ def sweep(n: int, out_dir: str) -> dict:
             "loss": round(float(ev["Loss"]), 4),
             "max_dev_ratio": max_dev_ratio(log),
             "twin_checked": twin is not None,
+            "block_checked": block_checked,
             "violations": v,
         })
         violations.extend(f"seed {seed}: {msg}" for msg in v)
@@ -343,6 +367,7 @@ def sweep(n: int, out_dir: str) -> dict:
         "scenarios": n,
         "aggregators_covered": sorted({r["agg"] for r in results}),
         "inertness_pairs": sum(r["twin_checked"] for r in results),
+        "block_pairs": sum(r["block_checked"] for r in results),
         "violations": violations,
         "ok": not violations,
         "results": results,

@@ -122,10 +122,30 @@ def summarize(records: List[dict]) -> dict:
             audit_summary["max_dev_ratio"] = max(ratios)
             audit_summary["mean_dev_ratio"] = sum(ratios) / len(ratios)
 
+    # round-block runs (Simulator.run(block_size>1)) emit `block`-rooted
+    # spans covering several rounds each; normalize them to per-round
+    # averages so the per-stage cost table stays comparable with per-round
+    # (`round`-rooted) traces — the `round` records are per-round in both
+    # worlds, so their count is the normalizer
+    block_summary = {}
+    block_root = spans.get("block")
+    if block_root and rounds:
+        block_summary = {
+            "blocks": block_root["count"],
+            "rounds": len(rounds),
+            "rounds_per_block": len(rounds) / block_root["count"],
+            "per_round_mean_s": {
+                path: s["total_s"] / len(rounds)
+                for path, s in spans.items()
+                if path == "block" or path.startswith("block/")
+            },
+        }
+
     return {
         "meta": meta,
         "spans": spans,
         "counters": counters,
+        "block": block_summary,
         "rounds": {
             "count": len(rounds),
             "total_wall_s": sum(round_walls),
@@ -171,6 +191,16 @@ def format_table(summary: dict) -> str:
             f"{path:<28}{s['count']:>7}{s['total_s']:>10.3f}"
             f"{s['mean_s'] * 1e3:>10.1f}{s['max_s'] * 1e3:>10.1f}{pct:>9.1f}"
         )
+    blk = summary.get("block") or {}
+    if blk:
+        lines.append(
+            f"\nblock execution: {blk['blocks']} blocks x "
+            f"~{blk['rounds_per_block']:.1f} rounds; per-round averages:"
+        )
+        for path, v in sorted(
+            blk["per_round_mean_s"].items(), key=lambda kv: -kv[1]
+        ):
+            lines.append(f"  {path:<26}{v * 1e3:>10.1f} ms/round")
     r = summary["rounds"]
     lines.append(
         f"\nrounds: {r['count']}  total {r['total_wall_s']:.3f}s  "

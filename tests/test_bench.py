@@ -180,6 +180,47 @@ def test_total_failure_carries_ladder_stage(bench, monkeypatch, capsys):
     assert code == 1 and payload["stage"] == "ladder"
 
 
+def test_tunnel_down_hint_skips_probe(bench, monkeypatch, capsys):
+    """BLADES_TUNNEL_DOWN=1 skips the liveness probe's full timeout budget
+    and drops straight to the labeled cpu_k8 fallback — a harness that
+    already paid for the tunnel-down knowledge should not pay again."""
+    monkeypatch.setenv("BLADES_TUNNEL_DOWN", "1")
+    cpu = ({"rounds_per_sec": 0.02, "clients": 8, "platform": "cpu"}, None)
+    payload, calls, code = run_main(bench, monkeypatch, capsys, [cpu])
+    assert code == 0
+    assert len(calls) == 1  # no probe child at all
+    assert calls[0]["BENCH_FORCE_CPU"] == 1
+    # an inherited BENCH_BLOCK must not inflate the pinned smoke rounds
+    assert calls[0]["BENCH_BLOCK"] == 1
+    assert payload["config"] == "cpu_k8"
+    assert "BLADES_TUNNEL_DOWN" in payload["attempt_errors"]
+
+
+def test_block_fields_ride_the_payload(bench, monkeypatch, capsys):
+    """Round-block amortization fields (block_size, rounds_per_launch)
+    pass through; a block>1 run is labeled non-headline (its timing is
+    amortized, not per-round cadence) while block_size=1 keeps the clean
+    headline."""
+    probe = ({"probe": "ok", "platform": "axon", "n_devices": 1}, None)
+    blk = ({"rounds_per_sec": 9.0, "clients": 1000, "platform": "axon",
+            "block_size": 8, "rounds_per_launch": 8.0}, None)
+    payload, _, code = run_main(bench, monkeypatch, capsys, [probe, blk])
+    assert code == 0
+    assert payload["block_size"] == 8
+    assert payload["rounds_per_launch"] == 8.0
+    assert payload["config"].endswith("_blk8")
+    assert payload["vs_baseline"] is None
+
+    probe = ({"probe": "ok", "platform": "axon", "n_devices": 1}, None)
+    one = ({"rounds_per_sec": 5.0, "clients": 1000, "platform": "axon",
+            "block_size": 1, "rounds_per_launch": 1.0}, None)
+    payload, _, code = run_main(bench, monkeypatch, capsys, [probe, one])
+    assert code == 0
+    assert payload["block_size"] == 1
+    assert "config" not in payload  # per-round path stays the headline
+    assert payload["vs_baseline"] is not None
+
+
 def test_make_agg_signature_dispatch(bench):
     """num_byzantine is forwarded only to constructors that declare it;
     no-arg aggregators (object.__init__) must neither crash nor silently

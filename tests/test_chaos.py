@@ -86,6 +86,18 @@ def test_inertness_twin_bit_identical_tier1(tmp_path):
     np.testing.assert_array_equal(p_nan, p_inf)
 
 
+def test_block_scheduling_neutral_under_faults_tier1(tmp_path):
+    """Invariant 6, tier-1 slice: the same chaos scenario run through
+    Simulator.run(block_size=2) — the scanned round-block program with the
+    sampler fused in, composed with this scenario's fault weather and the
+    record-only audit monitor — produces bit-identical final parameters
+    (3 rounds at block 2 also exercises the remainder block)."""
+    scn = chaos.make_scenario(1)
+    _, p_seq = chaos.run_scenario(scn, str(tmp_path / "seq"))
+    _, p_blk = chaos.run_scenario(scn, str(tmp_path / "blk"), block_size=2)
+    np.testing.assert_array_equal(p_seq, p_blk)
+
+
 # --------------------------------------------------------------- full sweep
 
 

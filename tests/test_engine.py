@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from blades_tpu.aggregators import get_aggregator
+from blades_tpu.aggregators import AGGREGATORS, get_aggregator
 from blades_tpu.attackers import get_attack
 from blades_tpu.core import ClientOptSpec, RoundEngine, ServerOptSpec
 from blades_tpu.core.engine import multistep_lr
@@ -400,3 +400,203 @@ def test_donate_batches_matches_and_consumes_inputs():
             eng_d.run_round(st_d, cx, cy, 0.1, 1.0, jax.random.PRNGKey(3))
     else:
         assert jax.default_backend() == "cpu"  # donation is a CPU no-op
+
+
+# -- round-block execution (run_block: sampler fused + lax.scan) ---------------
+
+BLOCK_K, BLOCK_F, BLOCK_C = 6, 12, 4
+
+
+def _tiny_loss(p, x, y, key):
+    logits = x.reshape(x.shape[0], -1) @ p["w"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+    top1 = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+    return loss, {"top1": top1}
+
+
+def _tiny_logits(p, x):
+    return x.reshape(x.shape[0], -1) @ p["w"]
+
+
+def _tiny_fixture(seed=0):
+    """Tiny FLDataset + linear params: registry-wide block tests stay
+    compile-cheap (D = 48)."""
+    from blades_tpu.datasets.fl import FLDataset
+
+    rng = np.random.RandomState(seed)
+    ds = FLDataset(
+        rng.randn(BLOCK_K, 20, BLOCK_F).astype(np.float32),
+        rng.randint(0, BLOCK_C, (BLOCK_K, 20)).astype(np.int32),
+        np.full(BLOCK_K, 20, np.int32),
+        rng.randn(30, BLOCK_F).astype(np.float32),
+        rng.randint(0, BLOCK_C, 30).astype(np.int32),
+    )
+    W0 = {"w": jnp.asarray(rng.randn(BLOCK_F, BLOCK_C).astype(np.float32) * 0.1)}
+    return ds, W0
+
+
+def _block_vs_sequential(engine_kw, rounds=3, lrs=(0.2, 0.1, 0.05)):
+    """Assert an R-round block is BIT-identical to R sequential run_round
+    calls: params, round_idx, every metric column, and (when surfaces are
+    installed) the final-round diagnostics."""
+    from blades_tpu.core import RoundEngine
+
+    ds, W0 = _tiny_fixture()
+    key = jax.random.PRNGKey(7)
+    dk = jax.random.fold_in(key, 23)
+    S, B = 2, 4
+
+    eng = RoundEngine(
+        _tiny_loss, _tiny_logits, W0, num_clients=BLOCK_K,
+        num_classes=BLOCK_C, **engine_kw,
+    )
+    st = eng.init(W0)
+    seq_metrics = []
+    for r in range(1, rounds + 1):
+        cx, cy = ds.sample_round(jax.random.fold_in(dk, r), S, B)
+        st, m = eng.run_round(st, cx, cy, lrs[r - 1], 1.0, key)
+        seq_metrics.append(m)
+
+    st2 = eng.init(W0)
+    keys = jnp.stack([jax.random.fold_in(dk, r) for r in range(1, rounds + 1)])
+    st2, ms, diags = eng.run_block(
+        st2, keys, list(lrs[:rounds]), [1.0] * rounds, key,
+        sampler=ds.traceable_sampler(S, B),
+    )
+
+    np.testing.assert_array_equal(
+        np.asarray(ravel(st.params)), np.asarray(ravel(st2.params))
+    )
+    assert int(st.round_idx) == int(st2.round_idx) == rounds
+    for i, m in enumerate(seq_metrics):
+        for field, col in zip(m, ms):
+            np.testing.assert_array_equal(np.asarray(field), np.asarray(col[i]))
+    # carried aggregator/fault state must match bit-for-bit too (the scan
+    # carry is the whole RoundState)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(st), jax.tree_util.tree_leaves(st2)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    return eng, diags
+
+
+@pytest.mark.parametrize("agg", sorted(AGGREGATORS))
+def test_block_matches_sequential_across_registry(agg):
+    """The load-bearing round-block invariant, across the FULL aggregator
+    registry (stateful defenses included — centeredclipping's momentum,
+    byzantinesgd's trajectory accumulators ride the scan carry): an R-round
+    block is bit-identical to R sequential rounds, so blocks are purely a
+    scheduling choice."""
+    agg_kws = (
+        {"num_byzantine": 2}
+        if agg in ("trimmedmean", "krum", "multikrum", "dnc")
+        else {}
+    )
+    kw = dict(
+        aggregator=get_aggregator(agg, **agg_kws),
+        num_byzantine=2,
+        attack=get_attack("ipm", epsilon=0.5),
+    )
+    if agg == "fltrust":
+        trusted = np.zeros(BLOCK_K, bool)
+        trusted[-1] = True
+        kw["trusted_mask"] = jnp.asarray(trusted)
+    _block_vs_sequential(kw)
+
+
+def test_block_matches_sequential_with_persisted_opt_faults_audit():
+    """Composition case: persisted per-client Adam moments, a straggler
+    fault model with a stale-replay buffer, and an enforced audit monitor
+    with in-graph fallback — every carried surface at once, block vs
+    sequential bit-exact, with the stacked per-round fault/audit
+    diagnostics present."""
+    from blades_tpu.audit.monitor import AuditMonitor
+    from blades_tpu.faults import FaultModel
+
+    kw = dict(
+        aggregator=get_aggregator("median"),
+        num_byzantine=2,
+        attack=get_attack("signflipping"),
+        client_opt=ClientOptSpec(name="adam", persist=True),
+        fault_model=FaultModel(
+            dropout_rate=0.3, straggler_rate=0.4, max_staleness=2,
+            corrupt_rate=0.2, corrupt_mode="nan",
+        ),
+        audit_monitor=AuditMonitor(
+            envelope_factor=1e-6, fallback_aggregator="median"
+        ),  # degenerate envelope: breaches fire, fallback swaps in-graph
+    )
+    eng, diags = _block_vs_sequential(kw)
+    assert diags["faults"] is not None and diags["audit"] is not None
+    assert np.asarray(diags["faults"]["participants"]).shape == (3,)
+    assert np.asarray(diags["audit"]["breach"]).sum() >= 1  # breaches fired
+
+
+def test_block_compile_count_pinned():
+    """A run schedules at most 2 block programs (full blocks + remainder):
+    re-running both shapes must add ZERO backend compiles — pinned through
+    the compile-counter telemetry, the same signal the driver gate reads."""
+    from blades_tpu.core import RoundEngine
+    from blades_tpu.telemetry import (
+        Recorder,
+        install_jax_monitoring,
+        set_recorder,
+    )
+
+    ds, W0 = _tiny_fixture(seed=3)
+    eng = RoundEngine(
+        _tiny_loss, _tiny_logits, W0, num_clients=BLOCK_K,
+        num_classes=BLOCK_C, aggregator=get_aggregator("mean"),
+    )
+    key = jax.random.PRNGKey(11)
+    dk = jax.random.fold_in(key, 23)
+    sampler = ds.traceable_sampler(1, 4)
+
+    def run_block(st, first, r):
+        keys = jnp.stack(
+            [jax.random.fold_in(dk, x) for x in range(first, first + r)]
+        )
+        st, ms, _ = eng.run_block(
+            st, keys, [0.1] * r, [1.0] * r, key, sampler=sampler
+        )
+        return st
+
+    rec = Recorder(enabled=True)
+    prev = set_recorder(rec)
+    try:
+        install_jax_monitoring()
+        st = eng.init(W0)
+        st = run_block(st, 1, 3)  # full block: compile 1
+        st = run_block(st, 4, 2)  # remainder block: compile 2
+        after_two_shapes = rec.counters.get("xla.compiles", 0)
+        st = run_block(st, 6, 3)  # same shapes again: no new programs
+        st = run_block(st, 9, 2)
+        assert rec.counters.get("xla.compiles", 0) == after_two_shapes
+    finally:
+        set_recorder(prev)
+
+
+def test_traceable_sampler_matches_sample_round():
+    """The fused (in-graph) sampler and the standalone jitted sampler are
+    the same function: identical draws for identical keys."""
+    ds, _ = _tiny_fixture(seed=5)
+    key = jax.random.PRNGKey(2)
+    cx_a, cy_a = ds.sample_round(key, 2, 4)
+    cx_b, cy_b = jax.jit(ds.traceable_sampler(2, 4))(key)
+    np.testing.assert_array_equal(np.asarray(cx_a), np.asarray(cx_b))
+    np.testing.assert_array_equal(np.asarray(cy_a), np.asarray(cy_b))
+
+
+def test_warm_eval_builds_the_eval_executable():
+    ds, W0 = _tiny_fixture()
+    from blades_tpu.core import RoundEngine
+
+    eng = RoundEngine(
+        _tiny_loss, _tiny_logits, W0, num_clients=BLOCK_K,
+        num_classes=BLOCK_C, aggregator=get_aggregator("mean"),
+    )
+    st = eng.init(W0)
+    eng.warm_eval(st.params, ds.test_x, ds.test_y, batch_size=16)
+    ev = eng.evaluate(st, ds.test_x, ds.test_y, batch_size=16)
+    assert np.isfinite(ev["Loss"]) and 0.0 <= ev["top1"] <= 1.0

@@ -214,6 +214,63 @@ def test_updates_dropped_by_default_kept_when_consumed(tmp_path):
     assert sim2.engine.last_updates is not None
 
 
+def test_block_run_matches_sequential_and_keeps_round_records(tmp_path):
+    """run(block_size=3) over 5 rounds (full block + remainder — the at-
+    most-2-programs shape set): bit-identical final params vs the
+    per-round path, per-round train/variance stats records and telemetry
+    round records all still present, spans at block granularity."""
+    import json
+
+    run_kw = dict(global_rounds=5, local_steps=1, train_batch_size=8,
+                  validate_interval=5, client_lr=0.3)
+    sim_a = _sim(tmp_path / "a", seed=7, num_byzantine=2, attack="ipm",
+                 aggregator="median")
+    times_a = sim_a.run("mlp", **run_kw)
+    ref = np.asarray(jnp.concatenate([
+        x.ravel() for x in jax.tree_util.tree_leaves(sim_a.server.state.params)
+    ]))
+
+    sim_b = _sim(tmp_path / "b", seed=7, num_byzantine=2, attack="ipm",
+                 aggregator="median")
+    times_b = sim_b.run("mlp", block_size=3, **run_kw)
+    out = np.asarray(jnp.concatenate([
+        x.ravel() for x in jax.tree_util.tree_leaves(sim_b.server.state.params)
+    ]))
+    np.testing.assert_array_equal(ref, out)
+    assert len(times_a) == len(times_b) == 5  # per-round walls (amortized)
+
+    # stats-file schema parity: one train + one variance record per ROUND
+    lines = open(sim_b.json_logger.handlers[0].baseFilename).readlines()
+    recs = [ast.literal_eval(l) for l in lines]
+    train = [r for r in recs if r["_meta"]["type"] == "train"]
+    assert [r["Round"] for r in train] == [1, 2, 3, 4, 5]
+
+    # telemetry: per-round round records; spans at block granularity
+    trecs = [json.loads(l)
+             for l in open(os.path.join(sim_b.log_path, "telemetry.jsonl"))]
+    rounds = [r for r in trecs if r["t"] == "round"]
+    assert [r["round"] for r in rounds] == [1, 2, 3, 4, 5]
+    spans = [r for r in trecs if r["t"] == "span"]
+    paths = {s["path"] for s in spans}
+    assert "block" in paths and "block/dispatch" in paths
+    assert "eval_warmup" in paths  # eager eval build, recorded as a span
+    blocks = [s for s in spans if s["path"] == "block"]
+    assert sorted(s["rounds"] for s in blocks) == [2, 3]  # full + remainder
+
+
+def test_block_size_falls_back_when_hooks_need_rounds(tmp_path):
+    """retain_updates/on_round_end need per-round host visibility: the run
+    silently (debug-noted) drops to per-round execution and the hook fires
+    every round."""
+    seen = []
+    sim = _sim(tmp_path)
+    sim.run("mlp", global_rounds=3, local_steps=1, train_batch_size=8,
+            validate_interval=3, block_size=3,
+            on_round_end=lambda r, s, m: seen.append(r))
+    assert seen == [1, 2, 3]
+    assert sim.engine.last_updates is not None  # per-round path kept them
+
+
 def test_run_with_donated_batches_matches(tmp_path):
     """run(donate_batches=True) must produce the same training as the
     default (built-in datasets sample fresh buffers every round, so

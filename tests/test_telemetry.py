@@ -295,3 +295,42 @@ def test_trace_summary_cli_main(tmp_path, capsys):
     empty = str(tmp_path / "empty.jsonl")
     open(empty, "w").close()
     assert trace_summary.main([empty]) == 1  # no records -> error exit
+
+
+def test_trace_summary_normalizes_block_spans(tmp_path, capsys):
+    """Round-block traces carry `block`-rooted spans covering several
+    rounds each; the summary normalizes them to per-round averages (using
+    the per-round round records as the denominator) so the per-stage cost
+    table stays comparable with pre-block, per-round traces."""
+    import trace_summary
+
+    path = str(tmp_path / "t.jsonl")
+    rec = Recorder(enabled=True, path=path)
+    for block, rounds in ((0, (1, 2, 3)), (1, (4, 5))):
+        with rec.span("block", rounds=len(rounds)):
+            with rec.span("dispatch"):
+                pass
+        for r in rounds:
+            rec.round_record(r, wall_s=0.2)
+    rec.close()
+    summary = trace_summary.summarize(trace_summary.load_records(path))
+    blk = summary["block"]
+    assert blk["blocks"] == 2 and blk["rounds"] == 5
+    assert blk["rounds_per_block"] == 2.5
+    assert set(blk["per_round_mean_s"]) == {"block", "block/dispatch"}
+    # per-round normalization: total block time / 5 rounds
+    assert blk["per_round_mean_s"]["block"] == pytest.approx(
+        summary["spans"]["block"]["total_s"] / 5
+    )
+    assert trace_summary.main([path]) == 0
+    assert "block execution" in capsys.readouterr().out
+    # a per-round trace has no block section (and the table omits it)
+    rec2 = Recorder(enabled=True, path=str(tmp_path / "r.jsonl"))
+    with rec2.span("round"):
+        pass
+    rec2.round_record(1, wall_s=0.1)
+    rec2.close()
+    s2 = trace_summary.summarize(
+        trace_summary.load_records(str(tmp_path / "r.jsonl"))
+    )
+    assert s2["block"] == {}
