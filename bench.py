@@ -119,12 +119,20 @@ def child_main() -> None:
     model_name = os.environ.get("BENCH_MODEL", "cct_2_3x2_32")
     # sequential client chunks bound activation HBM (see RoundEngine
     # docstring); 4 chunks of 250 clients measured best on v5e (sweep in
-    # docs/performance.md — flat within ~6% from 2 to 20 chunks).
-    # RoundEngine requires k % chunks == 0, so snap to the largest
-    # divisor of k not above the request (BENCH_CLIENTS=50 must not die);
-    # clamp first so BENCH_CHUNKS=0 is a clear floor, not an empty max()
-    chunks = max(1, int(os.environ.get("BENCH_CHUNKS", 4)))
-    chunks = max(c for c in range(1, chunks + 1) if k % c == 0)
+    # docs/performance.md — flat within ~6% from 2 to 20 chunks). The
+    # engine pads the final chunk, so any count in [1, k] is valid — the
+    # old silent snap-to-divisor is gone; the EFFECTIVE chunk count /
+    # chunk size / peak bytes are reported in the payload either way.
+    chunks = max(1, min(int(os.environ.get("BENCH_CHUNKS", 4)), k))
+    # streaming client axis: chunk-scan the round and aggregate [chunk, D]
+    # slabs through the registry's streaming protocol — the [K, D] matrix
+    # is never materialized (the K >= 10^4 memory-scaling rows;
+    # results/streaming_k/)
+    streaming = os.environ.get("BENCH_STREAMING", "0") == "1"
+    # per-client sample count of the synthetic shard (data-axis host/HBM
+    # knob for the K-scaling ladder; the default matches the historical
+    # constant)
+    samples = int(os.environ.get("BENCH_SAMPLES", SAMPLES_PER_CLIENT))
     # BASELINE.md config-ladder knobs (configs 2-5 pair resnet18/wrn_28_10
     # with specific aggregator/attack/client-opt combinations)
     agg_name = os.environ.get("BENCH_AGG", "trimmedmean")
@@ -195,12 +203,12 @@ def child_main() -> None:
 
         rng = np.random.RandomState(0)
         train_x = rng.randint(
-            0, 256, (k, SAMPLES_PER_CLIENT, 32, 32, 3), dtype=np.uint8
+            0, 256, (k, samples, 32, 32, 3), dtype=np.uint8
         )
-        train_y = rng.randint(0, num_classes, (k, SAMPLES_PER_CLIENT)).astype(
+        train_y = rng.randint(0, num_classes, (k, samples)).astype(
             np.int32
         )
-        counts = np.full(k, SAMPLES_PER_CLIENT, np.int32)
+        counts = np.full(k, samples, np.int32)
         ds = FLDataset(
             train_x,
             train_y,
@@ -248,6 +256,7 @@ def child_main() -> None:
             # every round samples fresh batches, so their buffers are safe
             # to donate (~0.4 GB HBM back at the K=1000 headline)
             donate_batches=os.environ.get("BENCH_DONATE_BATCHES", "1") == "1",
+            streaming=streaming,
         )
         state = engine.init(params)
         key = jax.random.PRNGKey(7)
@@ -330,24 +339,54 @@ def child_main() -> None:
         # cost the telemetry fields account for
         counters = telem.snapshot()["counters"]
 
-        # isolated aggregation cost on the exact [K, D] update-matrix shape
-        # (stage (c) of scripts/stage_timing.py, now carried by every bench
-        # run); best-effort — an aggregator needing extra ctx reports null
+        # isolated aggregation cost on the exact update-matrix shape the
+        # round uses (stage (c) of scripts/stage_timing.py, now carried by
+        # every bench run); best-effort — an aggregator needing extra ctx
+        # reports null. Streaming runs must NOT allocate the dense [K, D]
+        # probe matrix (it is exactly what streaming exists to avoid): they
+        # time the streaming protocol over one reused [chunk, D] slab.
         stage = "agg_timing"
         agg_s = None
         try:
-            u = jax.random.normal(
-                jax.random.fold_in(key, 999), (k, engine.dim), jnp.float32
-            )
-            agg_state = agg.init_state(k, engine.dim)
-            agg_jit = jax.jit(
-                lambda mtx, st, kk: agg.aggregate(mtx, st, key=kk)[0]
-            )
+            from jax import lax as _lax
+
             akey = jax.random.fold_in(key, 998)
-            jax.block_until_ready(agg_jit(u, agg_state, akey))  # warm
+            agg_state = agg.init_state(k, engine.dim)
+            if streaming:
+                slab = jax.random.normal(
+                    jax.random.fold_in(key, 999),
+                    (engine.chunk_size, engine.dim), jnp.float32,
+                )
+                ones = jnp.ones(engine.chunk_size, bool)
+                c_eff = engine.client_chunks
+
+                def stream_agg(slab, st, kk):
+                    ss = agg.streaming_init(
+                        k, c_eff, engine.chunk_size, engine.dim, st
+                    )
+
+                    def body(ss, j):
+                        return agg.streaming_update(
+                            ss, slab, chunk_mask=ones, chunk_index=j, key=kk
+                        ), None
+
+                    ss, _ = _lax.scan(body, ss, jnp.arange(c_eff))
+                    return agg.streaming_finalize(ss, st, key=kk)[0]
+
+                agg_jit = jax.jit(stream_agg)
+                args = (slab, agg_state, akey)
+            else:
+                u = jax.random.normal(
+                    jax.random.fold_in(key, 999), (k, engine.dim), jnp.float32
+                )
+                agg_jit = jax.jit(
+                    lambda mtx, st, kk: agg.aggregate(mtx, st, key=kk)[0]
+                )
+                args = (u, agg_state, akey)
+            jax.block_until_ready(agg_jit(*args))  # warm
             t0 = time.time()
             for _ in range(5):
-                out = agg_jit(u, agg_state, akey)
+                out = agg_jit(*args)
             jax.block_until_ready(out)
             agg_s = (time.time() - t0) / 5
         except Exception:  # noqa: BLE001 - telemetry must not fail the bench
@@ -402,6 +441,15 @@ def child_main() -> None:
                 {
                     "rounds_per_sec": timed / elapsed,
                     "clients": k,
+                    # client-axis layout, self-describing (the engine may
+                    # clamp the requested chunk count and pads the final
+                    # chunk; peak_update_bytes is the round program's
+                    # update-matrix footprint — [K, D] dense, [chunk, D]
+                    # streaming)
+                    "client_chunks": engine.client_chunks,
+                    "chunk_size": engine.chunk_size,
+                    "streaming": engine.streaming,
+                    "peak_update_bytes": engine.peak_update_bytes,
                     # round-block amortization: rounds per program launch
                     # and the measured launch rate (launches == rounds when
                     # block_size == 1)
@@ -593,6 +641,12 @@ def _ladder_main() -> None:
     if result.get("block_size") is not None:
         payload["block_size"] = result["block_size"]
         payload["rounds_per_launch"] = result.get("rounds_per_launch")
+    # client-axis layout: effective chunking + the program's peak
+    # update-matrix bytes, so K-scaling rows are self-describing
+    for field in ("client_chunks", "chunk_size", "streaming",
+                  "peak_update_bytes"):
+        if result.get(field) is not None:
+            payload[field] = result[field]
     nondefault_model = result.get("model", "cct_2_3x2_32") != "cct_2_3x2_32"
     nondefault_agg = result.get("agg", "trimmedmean") != "trimmedmean"
     # any attacked / Adam-client / multi-step variant is not the headline
@@ -604,6 +658,9 @@ def _ladder_main() -> None:
         or result.get("local_steps", 1) != 1
         # block-amortized timing is not the per-round headline cadence
         or result.get("block_size", 1) != 1
+        # the streaming client axis trades per-round speed for K-scaling;
+        # its rows are memory evidence, never the headline
+        or bool(result.get("streaming"))
     )
     if (
         result["clients"] != full_k
@@ -630,6 +687,8 @@ def _ladder_main() -> None:
             )
             if result.get("block_size", 1) != 1:
                 payload["config"] += f"_blk{result['block_size']}"
+            if result.get("streaming"):
+                payload["config"] += f"_stream{result.get('client_chunks')}"
             payload["vs_baseline"] = None
     if errors:
         payload["attempt_errors"] = "; ".join(errors)[:500]

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Type, Union
 
-from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.aggregators.base import Aggregator, TwoLevelStreaming
 from blades_tpu.aggregators.mean import Mean
 from blades_tpu.aggregators.median import Median
 from blades_tpu.aggregators.trimmedmean import Trimmedmean
@@ -92,7 +92,8 @@ def register_aggregator(name: str, cls: Type[Aggregator]) -> None:
 
 
 __all__ = [
-    "Aggregator", "Mean", "Median", "Trimmedmean", "Krum", "Multikrum",
+    "Aggregator", "TwoLevelStreaming",
+    "Mean", "Median", "Trimmedmean", "Krum", "Multikrum",
     "Geomed", "Autogm", "Centeredclipping", "Clustering", "Clippedclustering",
     "Fltrust", "Byzantinesgd", "Dnc", "Signguard",
     "DecentralizedMixing", "AnchorClipping", "Asyncmean",

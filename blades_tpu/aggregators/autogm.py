@@ -18,11 +18,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.aggregators.base import Aggregator, TwoLevelStreaming
 from blades_tpu.aggregators.geomed import weiszfeld
 
 
-class Autogm(Aggregator):
+class Autogm(TwoLevelStreaming, Aggregator):
+    """Streaming form: two-level — the full auto-weighted solve within each
+    chunk (``lamb`` defaulting to the chunk population, its own K-scaling
+    rule applied per level), then again across the chunk aggregates. Same
+    rationale as :class:`~blades_tpu.aggregators.geomed.Geomed`: the
+    weight search re-ranks every row against the current iterate, so no
+    exact single-pass state smaller than the rows exists."""
     def __init__(
         self,
         lamb: float = None,

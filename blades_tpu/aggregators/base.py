@@ -29,6 +29,8 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from blades_tpu.ops.streaming import chunk_layout, stack_init, stack_write
+
 
 class Aggregator:
     """Base class for robust aggregators.
@@ -49,6 +51,22 @@ class Aggregator:
     #: Class-level and never mutated; subclasses override with their own
     #: literal dict.
     audit_optouts: dict = {}
+
+    #: Streaming-protocol opt-outs (chunk-scanned aggregation, enforced by
+    #: the tier-1 registry lint in ``tests/test_streaming.py``): a mapping
+    #: ``{"streaming": reason}`` documenting WHY a defense cannot consume
+    #: the update matrix as a single pass of ``[chunk, D]`` slabs (e.g. it
+    #: must pair every row with a statistic known only after the full
+    #: pass). Every registered aggregator either implements the streaming
+    #: path or carries an explicit reason here.
+    streaming_optouts: dict = {}
+
+    #: True when the streaming form computes the SAME estimator as the
+    #: dense :meth:`aggregate` (differences bounded by floating-point
+    #: re-association of chunk partial sums); False for documented
+    #: *two-level* forms ("aggregate the chunk-aggregates"), whose
+    #: approximation error the streaming test suite bounds instead.
+    streaming_exact: bool = False
 
     def init_state(self, num_clients: int, dim: int) -> Any:
         """Initial carry for stateful aggregators; ``()`` when stateless."""
@@ -139,6 +157,104 @@ class Aggregator:
         agg, new_state = self._masked_aggregate(safe, state, mask=mask, **ctx)
         return agg, new_state, self.diagnostics(safe, state, mask=mask, **ctx)
 
+    # -- streaming (chunk-scanned) aggregation --------------------------------
+    #
+    # The dense surfaces above consume the full [K, D] update matrix; the
+    # streaming protocol consumes it as a single ordered pass of [chunk, D]
+    # slabs so the engine never materializes [K, D] (core/engine.py with
+    # ``streaming=True``; peak update memory [chunk, D] + the [num_chunks,
+    # ...] summaries carried in the stream state). Contract:
+    #
+    #   sstate = agg.streaming_init(num_clients, num_chunks, chunk_size,
+    #                               dim, state)
+    #   for j in range(num_chunks):           # inside lax.scan in the engine
+    #       sstate = agg.streaming_update(sstate, slab_j, chunk_mask=m_j,
+    #                                     chunk_index=j, **ctx)
+    #   agg_vec, new_state = agg.streaming_finalize(sstate, state, **ctx)
+    #
+    # Slabs arrive SANITIZED (masked-out rows zeroed, same `_sanitize` rule
+    # as the mask API) and the chunk mask covers both fault-excluded rows
+    # and the engine's padded final chunk. `streaming_exact` declares
+    # whether the finalized aggregate is the dense estimator (mean-family)
+    # or a documented two-level approximation (`TwoLevelStreaming`).
+
+    def supports_streaming(self) -> bool:
+        """True when this aggregator implements the streaming protocol."""
+        return type(self).streaming_update is not Aggregator.streaming_update
+
+    def streaming_init(
+        self, num_clients: int, num_chunks: int, chunk_size: int, dim: int,
+        state: Any = (),
+    ) -> Any:
+        """Initial streaming reduction state (fixed shapes, scan-carry safe).
+        ``state`` is the aggregator's cross-round state at round start (the
+        momentum/ring-buffer the streaming pass may need)."""
+        raise NotImplementedError(self._no_streaming_msg())
+
+    def streaming_update(
+        self,
+        sstate: Any,
+        chunk_updates: jnp.ndarray,
+        *,
+        chunk_mask: jnp.ndarray,
+        chunk_index: jnp.ndarray,
+        **ctx,
+    ) -> Any:
+        """Fold one sanitized ``[chunk, D]`` slab into the stream state."""
+        raise NotImplementedError(self._no_streaming_msg())
+
+    def streaming_finalize(
+        self, sstate: Any, state: Any = (), **ctx
+    ) -> Tuple[jnp.ndarray, Any]:
+        """Finalize ``(aggregate [D], new cross-round state)`` from the
+        stream state after every chunk has been consumed."""
+        raise NotImplementedError(self._no_streaming_msg())
+
+    def _no_streaming_msg(self) -> str:
+        reason = self.streaming_optouts.get("streaming")
+        why = f" ({reason})" if reason else ""
+        return (
+            f"{type(self).__name__} does not implement streaming "
+            f"aggregation{why}; use the dense path or a streaming-capable "
+            "defense (docs/performance.md, 'Memory scaling')"
+        )
+
+    def aggregate_streaming(
+        self,
+        updates: jnp.ndarray,
+        state: Any = (),
+        *,
+        num_chunks: int = 1,
+        mask: Optional[jnp.ndarray] = None,
+        **ctx,
+    ) -> Tuple[jnp.ndarray, Any]:
+        """Reference driver for the streaming protocol over a dense matrix.
+
+        Chunks the ``[K, D]`` matrix exactly the way the engine's chunk
+        scan does (``ceil(K / num_chunks)`` rows per chunk, padded final
+        chunk masked out) and runs init → update per chunk → finalize.
+        This is the semantic definition the streaming tests pin against
+        the dense path — and a host-side convenience for auditing a
+        defense's streaming form outside the engine.
+        """
+        k, d = updates.shape
+        c, chunk, pad = chunk_layout(k, num_chunks)
+        mask = (
+            jnp.ones(k, bool) if mask is None else jnp.asarray(mask).astype(bool)
+        )
+        if pad:
+            updates = jnp.pad(updates, ((0, pad), (0, 0)))
+            mask = jnp.pad(mask, (0, pad))
+        sstate = self.streaming_init(k, c, chunk, d, state)
+        for j in range(c):
+            rows = slice(j * chunk, (j + 1) * chunk)
+            m_c, safe = self._sanitize(updates[rows], mask[rows])
+            sstate = self.streaming_update(
+                sstate, safe, chunk_mask=m_c,
+                chunk_index=jnp.asarray(j, jnp.int32), **ctx,
+            )
+        return self.streaming_finalize(sstate, state, **ctx)
+
     # -- forensics ------------------------------------------------------------
 
     def diagnostics(self, updates: jnp.ndarray, state: Any = (), **ctx) -> dict:
@@ -188,3 +304,69 @@ class Aggregator:
 
     def __repr__(self) -> str:
         return type(self).__name__
+
+
+class TwoLevelStreaming:
+    """Generic *two-level* streaming form: run the defense chunk-locally,
+    then run it again over the ``[num_chunks, D]`` stack of chunk
+    aggregates ("aggregate the chunk-aggregates").
+
+    This is the standard hierarchical approximation for order-statistic
+    defenses with no exact single-pass form (median-of-medians,
+    chunk-local trimming/Krum): every level applies the SAME robust rule,
+    so a byzantine minority must first capture a chunk and then a majority
+    of chunk aggregates to move the result. It is NOT the dense estimator —
+    the deviation is bounded by the tests in ``tests/test_streaming.py``
+    (the two-level result of hull-valued defenses stays inside the
+    participants' convex hull, so ``|two_level - dense|`` is bounded by the
+    update diameter; on concentrated honest updates the two agree to the
+    honest spread).
+
+    Mix in BEFORE :class:`Aggregator` and override, when needed:
+
+    - :meth:`_chunk_aggregate` — the chunk-local statistic (default: the
+      defense's own ``_masked_aggregate`` from a fresh empty state);
+    - :meth:`_combine_chunk_aggs` — the finalize-level recombination
+      (default: the defense's own ``_masked_aggregate`` over the stack,
+      empty chunks masked out).
+
+    Single-row levels short-circuit (``chunk_size == 1`` /
+    ``num_chunks == 1``): a one-row population's robust aggregate is the
+    row itself, and several defenses' full machinery (Krum neighborhoods,
+    2-clustering) is undefined there.
+    """
+
+    def streaming_init(self, num_clients, num_chunks, chunk_size, dim, state=()):
+        return {
+            "aggs": stack_init(num_chunks, (dim,)),
+            "counts": jnp.zeros((num_chunks,), jnp.int32),
+        }
+
+    def streaming_update(
+        self, sstate, chunk_updates, *, chunk_mask, chunk_index, **ctx
+    ):
+        n = jnp.sum(chunk_mask.astype(jnp.int32))
+        if chunk_updates.shape[0] == 1:
+            agg = chunk_updates[0]
+        else:
+            agg = self._chunk_aggregate(chunk_updates, chunk_mask=chunk_mask, **ctx)
+        agg = jnp.where(n > 0, agg, jnp.zeros_like(agg))
+        return {
+            "aggs": stack_write(sstate["aggs"], chunk_index, agg),
+            "counts": stack_write(sstate["counts"], chunk_index, n),
+        }
+
+    def streaming_finalize(self, sstate, state=(), **ctx):
+        aggs, counts = sstate["aggs"], sstate["counts"]
+        if aggs.shape[0] == 1:
+            agg = jnp.where(counts[0] > 0, aggs[0], jnp.zeros_like(aggs[0]))
+            return agg, state
+        return self._combine_chunk_aggs(aggs, counts, state, **ctx)
+
+    def _chunk_aggregate(self, slab, *, chunk_mask, **ctx):
+        agg, _ = self._masked_aggregate(slab, (), mask=chunk_mask, **ctx)
+        return agg
+
+    def _combine_chunk_aggs(self, aggs, counts, state, **ctx):
+        agg, _ = self._masked_aggregate(aggs, (), mask=counts > 0, **ctx)
+        return jnp.where(jnp.sum(counts) > 0, agg, jnp.zeros_like(agg)), state

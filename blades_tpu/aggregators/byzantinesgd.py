@@ -31,6 +31,18 @@ def _vector_median_idx(vs: jnp.ndarray, threshold: float) -> jnp.ndarray:
 class Byzantinesgd(Aggregator):
     stateful = True
 
+    # streaming opt-out (tests/test_streaming.py registry lint): the
+    # defense's own cross-round state is the per-client [K, D] accumulator
+    # matrix B, and its filters take vector medians ACROSS clients of B and
+    # of the raw updates — the memory the streaming engine exists to avoid
+    # is this defense's definition, not an implementation detail.
+    streaming_optouts = {
+        "streaming": "per-client B accumulators are themselves [K, D] "
+                     "state and the median-distance filters compare every "
+                     "client against every other; the defense is "
+                     "inherently dense in K",
+    }
+
     def __init__(self, th_A: float = 1.0, th_B: float = 1.0, th_V: float = 1.0):
         self.th_A = th_A
         self.th_B = th_B

@@ -16,9 +16,22 @@ import jax
 import jax.numpy as jnp
 
 from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.ops.streaming import stack_init, stack_write, weighted_stack_mean
 
 
 class Centeredclipping(Aggregator):
+    """Streaming form: each chunk runs the full ``n_iter`` clipping
+    iteration from the SHARED round-start momentum ``v0`` (the aggregator
+    state — known before the pass), producing a chunk momentum; finalize
+    is the participant-count-weighted mean of chunk momenta. For
+    ``n_iter == 1`` this is EXACT: the single iteration is
+    ``v0 + mean_i clip(u_i - v0)``, and the count-weighted mean of chunk
+    means recombines it exactly (``streaming_exact`` reflects that). For
+    ``n_iter > 1`` later iterations re-clip every row around an updated
+    center known only after a full pass, so the chunk-local iteration is a
+    documented two-level approximation (bounded in
+    ``tests/test_streaming.py``)."""
+
     stateful = True
 
     def __init__(self, tau: float = 10.0, n_iter: int = 5):
@@ -60,6 +73,51 @@ class Centeredclipping(Aggregator):
             return momentum + jnp.sum(clipped * m[:, None], axis=0) / denom
 
         momentum = jax.lax.fori_loop(0, self.n_iter, body, state.astype(updates.dtype))
+        return momentum, momentum
+
+    @property
+    def streaming_exact(self):  # type: ignore[override]
+        # one inner iteration decomposes exactly over chunks (see class
+        # docstring); more re-center against a mid-pass statistic
+        return self.n_iter == 1
+
+    def streaming_init(self, num_clients, num_chunks, chunk_size, dim, state=()):
+        v0 = (
+            jnp.zeros((dim,), jnp.float32)
+            if state is None or (isinstance(state, tuple) and state == ())
+            else jnp.asarray(state)
+        )
+        return {
+            "v0": v0,
+            "momenta": stack_init(num_chunks, (dim,)),
+            "counts": jnp.zeros((num_chunks,), jnp.int32),
+        }
+
+    def streaming_update(
+        self, sstate, chunk_updates, *, chunk_mask, chunk_index, **ctx
+    ):
+        m_j, _ = self._masked_aggregate(
+            chunk_updates, sstate["v0"], mask=chunk_mask
+        )
+        n = jnp.sum(chunk_mask.astype(jnp.int32))
+        return {
+            "v0": sstate["v0"],
+            "momenta": stack_write(sstate["momenta"], chunk_index, m_j),
+            "counts": stack_write(sstate["counts"], chunk_index, n),
+        }
+
+    def streaming_finalize(self, sstate, state=(), **ctx):
+        total = jnp.sum(sstate["counts"])
+        if sstate["momenta"].shape[0] == 1:
+            # single chunk: its momentum IS the result (the weighted mean
+            # would multiply-and-divide by the count — same value, different
+            # bits; the short-circuit keeps num_chunks=1 bit-exact)
+            v = sstate["momenta"][0]
+        else:
+            v = weighted_stack_mean(sstate["momenta"], sstate["counts"])
+        # an empty round moves nothing: momentum (and therefore the next
+        # round's state) stays at v0, matching the dense masked path
+        momentum = jnp.where(total > 0, v, sstate["v0"])
         return momentum, momentum
 
     def diagnostics(self, updates, state=(), **ctx):

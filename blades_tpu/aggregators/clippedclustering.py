@@ -20,9 +20,25 @@ import jax.numpy as jnp
 from blades_tpu.aggregators.base import Aggregator
 from blades_tpu.aggregators.clustering import Clustering
 from blades_tpu.ops.masked import masked_median_1d
+from blades_tpu.ops.streaming import stack_init, stack_write
 
 
 class Clippedclustering(Aggregator):
+    """Streaming form (two-level, documented deviations): the clip
+    threshold is the median of the norm history *as of round start* — one
+    round LAGGED relative to the dense path, which appends the current
+    round's norms before taking the median (the current norms are only all
+    known after the pass; on the very first round the empty history yields
+    an infinite threshold, i.e. no clipping). Rows are clipped chunk-
+    locally against that threshold, clustered chunk-locally, and the chunk
+    aggregates are clustered again at finalize; the ring buffer ingests
+    exactly ``num_clients`` entries per round in pass order (the final
+    chunk's zero-pad slots are skipped), with two chunk-local deviations
+    from the dense write rule: absent slots record the CHUNK participant
+    median rather than the round median (same neutrality argument,
+    chunk-local scope), and a zero-participant chunk suppresses its own
+    write where the dense path suppresses only fully-empty rounds."""
+
     stateful = True
 
     # certification opt-out (blades_tpu.audit): norm clipping to the
@@ -120,3 +136,102 @@ class Clippedclustering(Aggregator):
         )
         agg, _ = self._clustering._masked_aggregate(clipped, (), mask=mask)
         return agg, new_state
+
+    # -- streaming (see class docstring for the documented deviations) -------
+
+    def streaming_init(self, num_clients, num_chunks, chunk_size, dim, state=()):
+        if self.tau is not None:
+            thresh = jnp.asarray(self.tau, jnp.float32)
+        else:
+            # round-start (lagged) threshold: the dense path's median also
+            # includes THIS round's norms, which a single pass cannot know
+            thresh = self._masked_median(state["norms"], state["count"])
+        # the final chunk's zero-pad rows must NOT ingest phantom history
+        # entries: exactly num_clients norms enter the ring per round,
+        # matching the dense path's write count
+        pad = num_chunks * chunk_size - num_clients
+        return {
+            "thresh": thresh,
+            "hist": state["norms"],
+            "pos": state["pos"],
+            "count": state["count"],
+            "pad": jnp.asarray(pad, jnp.int32),
+            "last": jnp.asarray(num_chunks - 1, jnp.int32),
+            "aggs": stack_init(num_chunks, (dim,)),
+            "counts": jnp.zeros((num_chunks,), jnp.int32),
+        }
+
+    def streaming_update(
+        self, sstate, chunk_updates, *, chunk_mask, chunk_index, **ctx
+    ):
+        k = chunk_updates.shape[0]
+        norms = jnp.sqrt(jnp.maximum(jnp.sum(chunk_updates**2, axis=1), 0.0))
+        n = jnp.sum(chunk_mask.astype(jnp.int32))
+        any_part = n > 0
+
+        # ring-buffer ingest in pass order; absent clients' slots record
+        # the chunk participant median (neutral for the buffer's only
+        # consumer), the final chunk's zero-pad slots are skipped entirely
+        # (write count per round == num_clients, dense parity), and empty
+        # chunks suppress the whole write
+        med_chunk = masked_median_1d(norms, chunk_mask)
+        writes = jnp.where(chunk_mask, norms, med_chunk).astype(jnp.float32)
+        n_slots = k - jnp.where(
+            chunk_index == sstate["last"], sstate["pad"], 0
+        )
+        slot_ok = jnp.arange(k) < n_slots
+        cap = self.history_cap
+        idx = (sstate["pos"] + jnp.arange(k)) % cap
+        vals = jnp.where(slot_ok, writes, sstate["hist"][idx])
+        hist = jnp.where(
+            any_part, sstate["hist"].at[idx].set(vals), sstate["hist"]
+        )
+        pos = jnp.where(
+            any_part, (sstate["pos"] + n_slots) % cap, sstate["pos"]
+        )
+        count = jnp.where(
+            any_part,
+            jnp.minimum(sstate["count"] + n_slots, cap),
+            sstate["count"],
+        )
+
+        thresh = sstate["thresh"].astype(chunk_updates.dtype)
+        coef = jnp.minimum(1.0, thresh / (norms + 1e-6))
+        clipped = jnp.where(
+            (norms > thresh)[:, None],
+            chunk_updates * coef[:, None],
+            chunk_updates,
+        )
+        if k == 1:
+            agg = clipped[0]
+        else:
+            agg, _ = self._clustering._masked_aggregate(
+                clipped, (), mask=chunk_mask
+            )
+        agg = jnp.where(any_part, agg, jnp.zeros_like(agg))
+        return {
+            "thresh": sstate["thresh"],
+            "hist": hist,
+            "pos": pos,
+            "count": count,
+            "pad": sstate["pad"],
+            "last": sstate["last"],
+            "aggs": stack_write(sstate["aggs"], chunk_index, agg),
+            "counts": stack_write(sstate["counts"], chunk_index, n),
+        }
+
+    def streaming_finalize(self, sstate, state=(), **ctx):
+        aggs, counts = sstate["aggs"], sstate["counts"]
+        new_state = {
+            "norms": sstate["hist"],
+            "pos": sstate["pos"],
+            "count": sstate["count"],
+        }
+        if aggs.shape[0] == 1:
+            agg = jnp.where(counts[0] > 0, aggs[0], jnp.zeros_like(aggs[0]))
+            return agg, new_state
+        agg, _ = self._clustering._masked_aggregate(aggs, (), mask=counts > 0)
+        return (
+            jnp.where(jnp.sum(counts) > 0, agg, jnp.zeros_like(agg)),
+            new_state,
+        )

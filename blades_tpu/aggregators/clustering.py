@@ -16,12 +16,18 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.aggregators.base import Aggregator, TwoLevelStreaming
 from blades_tpu.ops.clustering import complete_linkage_two_clusters, majority_cluster_mean
 from blades_tpu.ops.distances import pairwise_cosine_similarity
 
 
-class Clustering(Aggregator):
+class Clustering(TwoLevelStreaming, Aggregator):
+    """Streaming form: two-level — linkage + majority-mean within each
+    chunk, then the same clustering over the chunk aggregates. The linkage
+    needs the full pairwise matrix of its level's population, which is
+    exactly what the hierarchy keeps small (``chunk^2`` then
+    ``num_chunks^2``)."""
+
     # certification opt-outs (blades_tpu.audit): cosine features are
     # origin-anchored (no translation equivariance), and the DEFAULT
     # reference-parity metric feeds the similarity matrix to the linkage as

@@ -178,6 +178,10 @@ class Asyncmean(Aggregator):
                       "the (async) average arbitrarily far",
     }
 
+    # exact streaming form: sum of present rows / K is a running sum with a
+    # static denominator
+    streaming_exact = True
+
     def aggregate(self, updates, state=(), *, present: Optional[jnp.ndarray] = None, **ctx):
         k = updates.shape[0]
         if present is None:
@@ -190,6 +194,26 @@ class Asyncmean(Aggregator):
         # damping of absent workers is this family's defining semantics,
         # so it is kept (aggregate_masked already zeroed absent rows)
         return updates.sum(axis=0) / updates.shape[0], state
+
+    def streaming_init(self, num_clients, num_chunks, chunk_size, dim, state=()):
+        return {
+            "sum": jnp.zeros((dim,), jnp.float32),
+            # the static 1/K damping denominator (K = true population, not
+            # the padded chunk total)
+            "k": jnp.asarray(num_clients, jnp.float32),
+        }
+
+    def streaming_update(
+        self, sstate, chunk_updates, *, chunk_mask, chunk_index, **ctx
+    ):
+        w = chunk_mask.astype(chunk_updates.dtype)
+        return {
+            "sum": sstate["sum"] + jnp.sum(chunk_updates * w[:, None], axis=0),
+            "k": sstate["k"],
+        }
+
+    def streaming_finalize(self, sstate, state=(), **ctx):
+        return sstate["sum"] / sstate["k"], state
 
     def __repr__(self):
         return "Asyncmean"
@@ -237,6 +261,64 @@ class Asynccenteredclipping(Aggregator):
         # participation mask -> async `present` mask (1/K damping kept:
         # that deliberate under-step on absences is the async semantics)
         return self.aggregate(updates, state, present=mask)
+
+    @property
+    def streaming_exact(self):  # type: ignore[override]
+        return self.n_iter == 1
+
+    def supports_streaming(self) -> bool:  # type: ignore[override]
+        # exact single-pass form exists ONLY for n_iter=1 (see
+        # streaming_init); declaring non-support for n_iter>1 makes the
+        # engine reject the config at BUILD time with the documented
+        # reason instead of dying mid-trace
+        return self.n_iter == 1
+
+    @property
+    def streaming_optouts(self):  # type: ignore[override]
+        if self.n_iter == 1:
+            return {}
+        return {
+            "streaming": "n_iter>1 re-clips every row against a mid-pass "
+                         "center; only the n_iter=1 running clipped sum "
+                         "is a single-pass form",
+        }
+
+    def streaming_init(self, num_clients, num_chunks, chunk_size, dim, state=()):
+        # exact single-pass form for the default n_iter=1: the one
+        # iteration is v0 + sum_i clip(u_i - v0) / K, and clip depends only
+        # on the round-start momentum — a running clipped sum. More inner
+        # iterations would re-clip against a mid-pass center; nobody runs
+        # the async variant that way, so it stays unimplemented rather than
+        # silently approximated.
+        if self.n_iter != 1:
+            raise NotImplementedError(self._no_streaming_msg())
+        v0 = (
+            jnp.zeros((dim,), jnp.float32)
+            if state is None or (isinstance(state, tuple) and state == ())
+            else jnp.asarray(state)
+        )
+        return {
+            "v0": v0,
+            "clip_sum": jnp.zeros((dim,), jnp.float32),
+            "k": jnp.asarray(num_clients, jnp.float32),
+        }
+
+    def streaming_update(
+        self, sstate, chunk_updates, *, chunk_mask, chunk_index, **ctx
+    ):
+        diff = chunk_updates - sstate["v0"][None, :]
+        norm = jnp.linalg.norm(diff, axis=1, keepdims=True)
+        clipped = diff * jnp.minimum(1.0, self.tau / jnp.maximum(norm, 1e-12))
+        clipped = jnp.where(chunk_mask[:, None], clipped, 0.0)
+        return {
+            "v0": sstate["v0"],
+            "clip_sum": sstate["clip_sum"] + clipped.sum(axis=0),
+            "k": sstate["k"],
+        }
+
+    def streaming_finalize(self, sstate, state=(), **ctx):
+        momentum = sstate["v0"] + sstate["clip_sum"] / sstate["k"]
+        return momentum, momentum
 
     def __repr__(self):
         return f"Asynccenteredclipping(tau={self.tau}, n_iter={self.n_iter})"

@@ -31,6 +31,19 @@ def _top_singular_dir(x: jnp.ndarray, iters: int, key: jax.Array) -> jnp.ndarray
 
 
 class Dnc(Aggregator):
+    # streaming opt-out (tests/test_streaming.py registry lint): each
+    # iteration scores every row by its projection onto the top singular
+    # direction of the full centered submatrix — the direction exists only
+    # after the whole population is seen, and the scoring pass must then
+    # revisit every row (and the next iteration repeats both passes on the
+    # surviving set).
+    streaming_optouts = {
+        "streaming": "outlier scores project every row onto a population-"
+                     "level principal direction known only after the full "
+                     "pass; each of num_iters rounds needs a fresh "
+                     "two-pass sweep",
+    }
+
     def __init__(
         self,
         num_byzantine: int = 5,

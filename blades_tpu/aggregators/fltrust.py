@@ -32,6 +32,17 @@ class Fltrust(Aggregator):
                        "translation-equivariant",
     }
 
+    # streaming opt-out (tests/test_streaming.py registry lint): every
+    # row's trust weight is its cosine against the TRUSTED row's update —
+    # chunks delivered before the trusted client's chunk cannot be scored
+    # in a single pass, and retaining them until it arrives is the dense
+    # [K, D] matrix again.
+    streaming_optouts = {
+        "streaming": "trust reweighting pairs every row with the trusted "
+                     "update, which may arrive in any chunk; a single pass "
+                     "cannot revisit rows delivered before it",
+    }
+
     def __call__(self, inputs, **ctx):
         # host-side guard mirroring the reference's `assert len(trusted) == 1`
         mask = ctx.get("trusted_mask")

@@ -16,7 +16,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.aggregators.base import Aggregator, TwoLevelStreaming
 
 
 def weiszfeld(
@@ -79,7 +79,18 @@ def weiszfeld(
     return z
 
 
-class Geomed(Aggregator):
+class Geomed(TwoLevelStreaming, Aggregator):
+    """Streaming form: two-level — an exact Weiszfeld solve *within* each
+    chunk, then a participant-count-weighted Weiszfeld across the chunk
+    geometric medians (each Weiszfeld step consumes the ``[num_chunks, D]``
+    chunk stack, never the rows). The exact single-pass form does not
+    exist: Weiszfeld re-weights every ROW by its distance to the current
+    iterate, which is known only after the full pass — a single-pass state
+    would have to retain the rows, i.e. be ``[K, D]``. Both levels return
+    convex combinations of delivered rows (hull-bounded in
+    ``tests/test_streaming.py``); the chunk medians' ~1/sqrt(chunk)
+    concentration is the classic median-of-means argument."""
+
     def __init__(self, maxiter: int = 100, eps: float = 1e-6, ftol: float = 1e-10):
         self.maxiter = maxiter
         self.eps = eps
@@ -106,3 +117,19 @@ class Geomed(Aggregator):
         )
         n = jnp.sum(mask.astype(updates.dtype))
         return jnp.where(n > 0, z, jnp.zeros_like(z)), state
+
+    def _combine_chunk_aggs(self, aggs, counts, state, **ctx):
+        # count-weighted recombination: a chunk median representing n_j
+        # rows enters the across-chunk solve with mass n_j (the Weiszfeld
+        # alphas), so unequal participation does not skew the result
+        w = counts.astype(aggs.dtype)
+        total = jnp.sum(w)
+        z = weiszfeld(
+            aggs,
+            init_weights=w / jnp.maximum(total, 1.0),
+            maxiter=self.maxiter,
+            eps=self.eps,
+            ftol=self.ftol,
+            mask=counts > 0,
+        )
+        return jnp.where(total > 0, z, jnp.zeros_like(z)), state

@@ -18,11 +18,17 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.aggregators.base import Aggregator, TwoLevelStreaming
 from blades_tpu.ops.distances import pairwise_sq_euclidean
 
 
-class Krum(Aggregator):
+class Krum(TwoLevelStreaming, Aggregator):
+    """Streaming form: two-level — Krum-select within each chunk (``f``/``m``
+    clamped so the ``n >= 2f + 2`` neighborhood fits the chunk population),
+    then Krum again over the chunk winners. A byzantine row must win its
+    chunk AND the across-chunk selection; both levels return means of real
+    delivered rows, so the two-level result stays in the participants'
+    convex hull (bounded in ``tests/test_streaming.py``)."""
     def __init__(
         self,
         num_clients: int = None,
@@ -114,6 +120,29 @@ class Krum(Aggregator):
         sel = updates[top_m] * w[:, None]
         scale = jnp.asarray(self.m, updates.dtype) / m_eff.astype(updates.dtype)
         return jnp.mean(sel, axis=0) * scale, state
+
+    def _level_clone(self, k: int) -> "Krum":
+        """Krum instance whose ``f``/``m`` fit a ``k``-row level of the
+        two-level streaming hierarchy (``2f + 2 <= k``, ``m <= k``)."""
+        f = min(self.f, max((k - 2) // 2, 0))
+        m = min(self.m, k)
+        if (f, m) == (self.f, self.m):
+            return self
+        return Krum(
+            num_byzantine=f, num_selected=m, distance_power=self.distance_power
+        )
+
+    def _chunk_aggregate(self, slab, *, chunk_mask, **ctx):
+        agg, _ = self._level_clone(slab.shape[0])._masked_aggregate(
+            slab, (), mask=chunk_mask
+        )
+        return agg
+
+    def _combine_chunk_aggs(self, aggs, counts, state, **ctx):
+        agg, _ = self._level_clone(aggs.shape[0])._masked_aggregate(
+            aggs, (), mask=counts > 0
+        )
+        return jnp.where(jnp.sum(counts) > 0, agg, jnp.zeros_like(agg)), state
 
     def diagnostics(self, updates, state=(), **ctx):
         """Forensics: the full per-client score vector and the ``m``

@@ -20,8 +20,33 @@ class Mean(Aggregator):
                       "the average arbitrarily far from the honest mean",
     }
 
+    # exact streaming form: a mean is a running (sum, count) carry — the
+    # finalized estimator is the dense one, chunking only re-associates
+    # the floating-point summation
+    streaming_exact = True
+
     def aggregate(self, updates, state=(), **ctx):
         return jnp.mean(updates, axis=0), state
 
     def _masked_aggregate(self, updates, state, *, mask, **ctx):
         return masked_mean(updates, mask), state
+
+    def streaming_init(self, num_clients, num_chunks, chunk_size, dim, state=()):
+        # bare (sum, count) carry — no sumsq; the variance moments are the
+        # engine's metrics concern, not the mean's
+        return {
+            "sum": jnp.zeros((dim,), jnp.float32),
+            "count": jnp.zeros((), jnp.float32),
+        }
+
+    def streaming_update(
+        self, sstate, chunk_updates, *, chunk_mask, chunk_index, **ctx
+    ):
+        w = chunk_mask.astype(chunk_updates.dtype)
+        return {
+            "sum": sstate["sum"] + jnp.sum(chunk_updates * w[:, None], axis=0),
+            "count": sstate["count"] + jnp.sum(w),
+        }
+
+    def streaming_finalize(self, sstate, state=(), **ctx):
+        return sstate["sum"] / jnp.maximum(sstate["count"], 1.0), state

@@ -10,11 +10,16 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.aggregators.base import Aggregator, TwoLevelStreaming
 from blades_tpu.ops.masked import masked_median
 
 
-class Median(Aggregator):
+class Median(TwoLevelStreaming, Aggregator):
+    """Streaming form: two-level median-of-chunk-medians (the classic
+    median-of-means-style hierarchy) — each level is the same f < n/2
+    robust statistic, and the result stays within the participants'
+    per-coordinate range (bounded in ``tests/test_streaming.py``)."""
+
     def aggregate(self, updates, state=(), **ctx):
         return jnp.median(updates, axis=0), state
 

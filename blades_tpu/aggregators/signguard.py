@@ -17,12 +17,17 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.aggregators.base import Aggregator, TwoLevelStreaming
 from blades_tpu.ops.clustering import complete_linkage_two_clusters
 from blades_tpu.ops.masked import masked_median_1d
 
 
-class Signguard(Aggregator):
+class Signguard(TwoLevelStreaming, Aggregator):
+    """Streaming form: two-level — norm-band + sign-cluster filtering
+    within each chunk (chunk-local median norm as the band anchor), then
+    the same filters over the chunk aggregates. The full-population median
+    norm and majority sign-cluster are known only after the pass, so the
+    exact form would need a second visit to every row."""
     # certification opt-out (blades_tpu.audit): the norm band and the
     # (pos, zero, neg) sign statistics are origin-anchored — translating
     # every update changes both filters' features, so exact translation

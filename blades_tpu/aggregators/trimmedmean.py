@@ -12,12 +12,18 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.aggregators.base import Aggregator, TwoLevelStreaming
 from blades_tpu.ops.masked import masked_trimmed_mean
 from blades_tpu.ops.pallas_trimmed import trimmed_mean
 
 
-class Trimmedmean(Aggregator):
+class Trimmedmean(TwoLevelStreaming, Aggregator):
+    """Streaming form: two-level — trim ``b`` (auto-shrunk to the chunk
+    population by ``_effective_b``) within each chunk, then trim again
+    across the chunk aggregates. Byzantine values must survive a
+    chunk-local trim AND an across-chunk trim to reach the result; the
+    two-level estimate stays within the participants' per-coordinate range
+    (bounded in ``tests/test_streaming.py``)."""
     def __init__(self, num_byzantine: int = 5, nb: int = None):
         # `nb` mirrors the reference ctor arg name (`trimmedmean.py:24`).
         self.b = nb if nb is not None else num_byzantine

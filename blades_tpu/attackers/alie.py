@@ -20,6 +20,9 @@ from blades_tpu.attackers.base import Attack, honest_stats
 
 
 class Alie(Attack):
+    # omniscient: byzantine rows are built from honest-population moments
+    update_locality = "population"
+
     def __init__(
         self,
         num_clients: Optional[int] = None,

@@ -39,6 +39,15 @@ class Attack:
     #: skip dead code in the compiled program).
     trains_dishonestly: bool = False
 
+    #: What ``on_updates`` reads: ``"row"`` when each output row depends
+    #: only on its own input row (+ the mask/key) — such attacks apply
+    #: per-chunk in the streaming engine with identical semantics;
+    #: ``"population"`` when byzantine rows are computed from
+    #: full-population statistics (ALIE/IPM/minmax honest moments), which
+    #: the streaming engine cannot provide (it never materializes
+    #: ``[K, D]``) and therefore rejects at build time.
+    update_locality: str = "row"
+
     def init_state(self, num_clients: int, dim: int) -> Any:
         return ()
 

@@ -13,6 +13,9 @@ from blades_tpu.attackers.base import Attack, honest_stats
 
 
 class Ipm(Attack):
+    # omniscient: byzantine rows are built from the honest-population mean
+    update_locality = "population"
+
     def __init__(self, epsilon: float = 0.5):
         self.epsilon = float(epsilon)
 

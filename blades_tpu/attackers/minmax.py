@@ -27,6 +27,8 @@ from blades_tpu.ops.distances import pairwise_sq_euclidean
 
 
 class _GammaScaled(Attack):
+    # omniscient: the gamma search spans the full honest population
+    update_locality = "population"
     n_bisect: int = 20
     gamma_init: float = 10.0
 

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 
 from blades_tpu.ops.distances import pairwise_sq_euclidean
 from blades_tpu.ops.masked import masked_mean, masked_median, masked_median_1d
+from blades_tpu.ops.streaming import chunk_geometry, stack_init, stack_write
 
 CERTIFICATE_NAMES = ("median_ball", "envelope")
 
@@ -199,6 +200,152 @@ class AuditMonitor:
             diag["max_honest_dev"] = jnp.where(has_h, hd, 0.0)
             diag["dev_honest"] = jnp.where(has_h, _norm(final - mu_h), 0.0)
             diag["dev_honest_raw"] = jnp.where(has_h, _norm(agg - mu_h), 0.0)
+        return final, diag
+
+    # -- streaming (chunk-scanned) certificates -------------------------------
+    #
+    # At streaming scale the [K, D] matrix the dense certificates read never
+    # exists. The streaming form keeps, per chunk: the chunk's coordinate-
+    # wise median ([num_chunks, D] stack), each row's distance to ITS chunk
+    # median ([num_chunks, chunk] scalars), the chunk radius, and the exact
+    # within-chunk diameter. At finalize the two-level median med_s (median
+    # of chunk medians) and the triangle inequality
+    #     | ||u_i - p|| - ||c_j - p|| |  <=  d_i  <=  ||u_i - p|| + ||c_j - p||
+    # give INTERVAL BOUNDS on every dense row statistic against any point p
+    # known only post-pass (med_s, the aggregate). Certificates then breach
+    # only when confident — dev compared against the spread's UPPER bound,
+    # reach's LOWER bound against the diameter's UPPER bound — so a flagged
+    # breach is genuine under the chunk approximation, while borderline
+    # breaches inside the approximation slack may pass (the tolerant
+    # direction; both bounds land in the diag for forensics). Singleton
+    # chunks collapse every interval to a point and the streaming
+    # certificates equal the dense ones exactly (tested).
+
+    def streaming_init(
+        self, num_clients: int, num_chunks: int, chunk_size: int, dim: int
+    ) -> dict:
+        return {
+            "meds": stack_init(num_chunks, (dim,)),
+            "counts": jnp.zeros((num_chunks,), jnp.int32),
+            "row_dist": stack_init(num_chunks, (chunk_size,)),
+            "row_mask": jnp.zeros((num_chunks, chunk_size), bool),
+            "radius": jnp.zeros((num_chunks,), jnp.float32),
+            "diam": jnp.zeros((num_chunks,), jnp.float32),
+        }
+
+    def streaming_update(
+        self, astate: dict, slab: jnp.ndarray, *, chunk_mask: jnp.ndarray,
+        chunk_index,
+    ) -> dict:
+        med_c = masked_median(slab, chunk_mask)
+        geo = chunk_geometry(slab, chunk_mask, med_c)
+        n = jnp.sum(chunk_mask.astype(jnp.int32))
+        return {
+            "meds": stack_write(astate["meds"], chunk_index,
+                                jnp.where(n > 0, med_c, 0.0)),
+            "counts": stack_write(astate["counts"], chunk_index, n),
+            "row_dist": stack_write(astate["row_dist"], chunk_index,
+                                    geo["row_dist"]),
+            "row_mask": stack_write(astate["row_mask"], chunk_index,
+                                    chunk_mask),
+            "radius": stack_write(astate["radius"], chunk_index,
+                                  geo["radius"]),
+            "diam": stack_write(astate["diam"], chunk_index, geo["diameter"]),
+        }
+
+    def streaming_apply(
+        self,
+        astate: dict,
+        agg: jnp.ndarray,
+        *,
+        fallback_agg: Optional[jnp.ndarray] = None,
+    ) -> Tuple[jnp.ndarray, dict]:
+        """Finalize the streaming certificates against the finalized
+        aggregate; on confident breach swap in ``fallback_agg`` (the
+        fallback aggregator's own streaming finalize, computed by the
+        engine in the same scan). Mirrors :meth:`apply`'s diag schema with
+        bound-valued spread/diameter fields plus the explicit lo/hi
+        interval forensics; the dense oracle's honest-reference fields
+        (``dev_honest``/``max_honest_dev``) need the rows and are dense-only.
+        """
+        meds, counts = astate["meds"], astate["counts"]
+        chunk_ok = counts > 0
+        n = jnp.sum(counts)
+        med_s = masked_median(meds, chunk_ok)
+
+        # per-chunk center offsets against finalize-time points
+        e_med = jnp.where(chunk_ok, _row_dists(meds, med_s), 0.0)  # ||c_j-med||
+        e_agg = jnp.where(chunk_ok, _row_dists(meds, agg), 0.0)    # ||c_j-agg||
+
+        d = astate["row_dist"]          # [C, chunk] row -> own-chunk median
+        rmask = astate["row_mask"]      # [C, chunk]
+        lo = jnp.maximum(d - e_med[:, None], 0.0)
+        hi = d + e_med[:, None]
+        r_hat_lo = masked_median_1d(lo.reshape(-1), rmask.reshape(-1))
+        r_hat_hi = masked_median_1d(hi.reshape(-1), rmask.reshape(-1))
+
+        dev_med = _norm(agg - med_s)
+        slack_med = 1e-6 * (1.0 + _norm(med_s))
+        median_ok = dev_med <= self.median_ball_factor * r_hat_hi + slack_med
+
+        radius = astate["radius"]
+        reach_hi = jnp.max(jnp.where(chunk_ok, e_agg + radius, 0.0))
+        reach_lo = jnp.max(
+            jnp.where(chunk_ok, jnp.maximum(e_agg - radius, 0.0), 0.0)
+        )
+        # cross-chunk diameter bounds from center distances +/- radii;
+        # the diagonal term (2 r_j) dominates the exact in-chunk diameter,
+        # so the pair formula alone is a valid upper bound
+        cdist = jnp.sqrt(jnp.maximum(pairwise_sq_euclidean(meds), 0.0))
+        pair_ok = chunk_ok[:, None] & chunk_ok[None, :]
+        diam_hi = jnp.max(
+            jnp.where(
+                pair_ok,
+                cdist + radius[:, None] + radius[None, :],
+                0.0,
+            )
+        )
+        diam_lo = jnp.maximum(
+            jnp.max(jnp.where(chunk_ok, astate["diam"], 0.0)),
+            jnp.max(
+                jnp.where(
+                    pair_ok,
+                    cdist - radius[:, None] - radius[None, :],
+                    0.0,
+                )
+            ),
+        )
+        slack_env = 1e-6 * (1.0 + diam_hi)
+        envelope_ok = reach_lo <= self.envelope_factor * diam_hi + slack_env
+
+        ok = jnp.ones((), bool)
+        if "median_ball" in self.certificates:
+            ok = ok & median_ok
+        if "envelope" in self.certificates:
+            ok = ok & envelope_ok
+        breach = (n > 0) & ~ok
+
+        final = agg
+        fallback_used = jnp.zeros((), bool)
+        if fallback_agg is not None:
+            final = jnp.where(breach, fallback_agg, agg)
+            fallback_used = breach
+
+        diag = {
+            "participants": n,
+            "cert_median_ball": median_ok.astype(jnp.int32),
+            "cert_envelope": envelope_ok.astype(jnp.int32),
+            "dev_median": dev_med,
+            "spread_median": r_hat_hi,
+            "spread_median_lo": r_hat_lo,
+            "diameter": diam_hi,
+            "diameter_lo": diam_lo,
+            "agg_reach_lo": reach_lo,
+            "agg_reach_hi": reach_hi,
+            "breach": breach.astype(jnp.int32),
+            "fallback_used": fallback_used.astype(jnp.int32),
+            "agg_norm": _norm(final),
+        }
         return final, diag
 
     def __repr__(self) -> str:

@@ -44,6 +44,12 @@ from blades_tpu.attackers.base import Attack, NoAttack
 from blades_tpu.audit.monitor import AuditMonitor
 from blades_tpu.faults import FaultModel
 from blades_tpu.ops.pytree import make_unraveler, ravel
+from blades_tpu.ops.streaming import (
+    chunk_layout,
+    moments_init,
+    moments_update,
+    moments_var,
+)
 from blades_tpu.parallel.mesh import ShardingPlan
 from blades_tpu.telemetry import get_recorder
 from blades_tpu.utils import rng
@@ -169,15 +175,43 @@ class RoundEngine:
         collect_diagnostics: bool = False,
         fault_model: Optional[FaultModel] = None,
         audit_monitor: Optional[AuditMonitor] = None,
+        streaming: bool = False,
     ):
         """``client_chunks``: split the K client axis into this many
         sequential chunks (``lax.map`` outside, vmap inside). Each chunk still
-        batches ``K/chunks x B`` samples through every layer — plenty to fill
-        the MXU — while activation memory scales with the chunk, not with K.
-        This is the HBM lever for large populations (K=1000 x CCT backward
-        would otherwise materialize 32k-image activations). ``remat``
-        additionally rematerializes each local step's forward during the
-        backward pass.
+        batches ``ceil(K/chunks) x B`` samples through every layer — plenty
+        to fill the MXU — while activation memory scales with the chunk, not
+        with K. This is the HBM lever for large populations (K=1000 x CCT
+        backward would otherwise materialize 32k-image activations). K need
+        not divide evenly: the final chunk is zero-padded and the padded
+        rows are sliced off (dense path) or masked out of every reduction
+        (streaming path) before aggregation, so any ``client_chunks`` in
+        ``[1, K]`` is valid. ``remat`` additionally rematerializes each
+        local step's forward during the backward pass.
+
+        ``streaming``: chunk-SCAN the whole round instead of merely
+        chunking the training activations — the per-chunk
+        train+attack+fault body runs under ``lax.scan`` and each
+        ``[chunk, D]`` update slab feeds the aggregator's streaming
+        reduction state (``Aggregator.streaming_*``), so the dense
+        ``[K, D]`` post-attack matrix is NEVER materialized and peak
+        update memory is ``[chunk_size, D]`` (plus ``[client_chunks, ...]``
+        chunk summaries) independent of K. Requirements, checked here so a
+        misconfiguration fails at build rather than trace time: the
+        aggregator (and the audit monitor's fallback) must implement the
+        streaming protocol (``streaming_optouts`` documents the three that
+        cannot); the attack's ``on_updates`` must be row-local
+        (omniscient ALIE/IPM/minmax need full-population honest moments);
+        the fault model must not configure stragglers (replay buffers are
+        ``[K, D]`` state); ``collect_diagnostics`` is unavailable
+        (forensics are defined on the dense matrix) and ``keep_updates``
+        is forced off (there is no matrix to keep). Exact-form aggregators
+        (``streaming_exact``) produce the dense estimator up to
+        floating-point re-association; two-level forms are documented
+        approximations bounded by ``tests/test_streaming.py``. Key-consuming
+        row-local surfaces (the noise attack, bit-flip corruption) draw
+        per-chunk folded keys, so their randomness is deterministic but not
+        bit-identical to the dense path's single ``[K, D]`` draw.
 
         ``keep_updates``: return the post-attack ``[K, D]`` update matrix
         as a program OUTPUT so callers can read ``self.last_updates``
@@ -232,20 +266,29 @@ class RoundEngine:
         self.num_classes = int(num_classes)
         self.loss_clamp = float(loss_clamp)
         self.plan = plan
-        self.client_chunks = int(client_chunks)
-        if self.num_clients % self.client_chunks:
-            raise ValueError(
-                f"num_clients {num_clients} not divisible by "
-                f"client_chunks {client_chunks}"
-            )
+        if int(client_chunks) < 1:
+            raise ValueError(f"client_chunks must be >= 1, got {client_chunks}")
+        # padded-chunk layout: ceil-sized chunks, final chunk zero-padded —
+        # K no longer has to be divisible by the chunk count, and the
+        # count renormalizes so no chunk is 100% padding (K=12 @ chunks=5
+        # -> 4 chunks of 3, not 5 with a fifth all-pad chunk trained and
+        # thrown away every round). chunk_layout is the single owner of
+        # the rule, shared with Aggregator.aggregate_streaming.
+        self.client_chunks, self.chunk_size, self._pad = chunk_layout(
+            self.num_clients, int(client_chunks)
+        )
         self.remat = bool(remat)
-        self.keep_updates = bool(keep_updates)
+        self.streaming = bool(streaming)
+        self.keep_updates = bool(keep_updates) and not self.streaming
         self.collect_diagnostics = bool(collect_diagnostics)
         self.last_diagnostics: Any = None
         self.fault_model = fault_model
         self.last_fault_diag: Any = None
         self.audit_monitor = audit_monitor
         self.last_audit_diag: Any = None
+        if self.streaming:
+            self._validate_streaming(aggregator, attack, fault_model,
+                                     audit_monitor, collect_diagnostics)
 
         self.dim, self.unravel = make_unraveler(params_template)
         # Reference convention: the FIRST num_byzantine client ids are
@@ -266,6 +309,61 @@ class RoundEngine:
         # the same jit object (at most 2 per run: full blocks + remainder)
         self._block_jit = None
         self._block_sampler = None
+
+    def _validate_streaming(
+        self, aggregator, attack, fault_model, audit_monitor, collect_diagnostics
+    ) -> None:
+        """Fail at engine build — not at trace time — when a configured
+        surface has no streaming form (each check names the documented
+        limitation; see the ``streaming`` docstring)."""
+        if self.aggregator is None or not self.aggregator.supports_streaming():
+            msg = (
+                "streaming=True requires an aggregator"
+                if self.aggregator is None
+                else self.aggregator._no_streaming_msg()
+            )
+            raise ValueError(msg)
+        if getattr(self.attack, "update_locality", "row") != "row":
+            raise ValueError(
+                f"streaming=True: attack {self.attack!r} rewrites updates "
+                "from full-population statistics (update_locality="
+                f"{self.attack.update_locality!r}); the chunk scan never "
+                "materializes the [K, D] matrix it needs"
+            )
+        if fault_model is not None and fault_model.has_stragglers:
+            raise ValueError(
+                "streaming=True: straggler replay buffers are [K, D] fault "
+                "state; streaming supports participation/corruption faults "
+                "only (straggler_rate=0)"
+            )
+        if collect_diagnostics:
+            raise ValueError(
+                "streaming=True cannot collect_diagnostics: aggregator "
+                "forensics are defined on the dense [K, D] matrix"
+            )
+        if audit_monitor is not None:
+            fb = audit_monitor.fallback_aggregator
+            if fb is not None and not fb.supports_streaming():
+                raise ValueError(
+                    "streaming=True: audit fallback " + fb._no_streaming_msg()
+                )
+
+    @property
+    def peak_update_bytes(self) -> int:
+        """Static estimate of the round program's peak update-matrix
+        footprint: the largest update-matrix-shaped buffer live at once.
+        Dense: the (padded) ``[K, D]`` float32 matrix. Streaming: one
+        ``[chunk_size, D]`` slab (the ``[client_chunks, D]`` chunk-summary
+        stacks of two-level aggregators are accounted separately — they
+        scale with the chunk COUNT, not with K). Surfaced per run as the
+        ``engine.peak_update_bytes`` telemetry gauge and in the bench
+        payload, so K-scaling memory regressions show up in traces."""
+        rows = (
+            self.chunk_size
+            if self.streaming
+            else self.num_clients + self._pad
+        )
+        return int(rows) * int(self.dim) * 4
 
     # -- state ---------------------------------------------------------------
 
@@ -320,6 +418,34 @@ class RoundEngine:
         )
 
     # -- the round program ---------------------------------------------------
+
+    def _chunk_fns(self):
+        """``(chunked, unchunk)`` for the padded chunk layout: ``chunked``
+        zero-pads the leading K axis to ``client_chunks * chunk_size`` and
+        folds it to ``[chunks, chunk_size, ...]``; ``unchunk`` inverts and
+        slices the padding back off. Zero-pad is exact: padded rows never
+        survive past ``unchunk`` (dense) or enter any reduction unmasked
+        (streaming)."""
+        c, cs, pad, k = (
+            self.client_chunks, self.chunk_size, self._pad, self.num_clients,
+        )
+
+        def chunked(t):
+            def f(a):
+                if pad:
+                    a = jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+                return a.reshape((c, cs) + a.shape[1:])
+
+            return jax.tree_util.tree_map(f, t)
+
+        def unchunk(t):
+            def f(a):
+                a = a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:])
+                return a[:k] if pad else a
+
+            return jax.tree_util.tree_map(f, t)
+
+        return chunked, unchunk
 
     def _local_update(self, params, opt_state, lr, cx, cy, ckey, is_byz, idx):
         """One client's local training; vmapped over the K axis. ``idx`` is
@@ -380,6 +506,14 @@ class RoundEngine:
         return update, ostf, losses.mean(), top1s.mean()
 
     def _round(self, state: RoundState, cx, cy, client_lr, server_lr, key):
+        """Static dispatch between the dense round body and the streaming
+        chunk scan — both trace to the same output structure, so
+        ``run_round``/``run_block`` never care which one compiled."""
+        if self.streaming:
+            return self._round_streaming(state, cx, cy, client_lr, server_lr, key)
+        return self._round_dense(state, cx, cy, client_lr, server_lr, key)
+
+    def _round_dense(self, state: RoundState, cx, cy, client_lr, server_lr, key):
         round_key = rng.key_for_round(key, state.round_idx)
         client_keys = rng.key_per_client(round_key, self.num_clients)
         attack_key = jax.random.fold_in(round_key, rng.ATTACK)
@@ -406,13 +540,10 @@ class RoundEngine:
             # HBM lever: sequential lax.map over client chunks, vmap inside.
             # Chunks occupy a fresh leading axis (unsharded); the inner client
             # axis keeps the mesh sharding, so every device still works on
-            # every chunk.
-            c = self.client_chunks
-
-            def chunked(t):
-                return jax.tree_util.tree_map(
-                    lambda a: a.reshape((c, a.shape[0] // c) + a.shape[1:]), t
-                )
+            # every chunk. The final chunk is zero-padded when K does not
+            # divide evenly; padded rows are sliced off right after the map,
+            # before any matrix the attack/defense sees.
+            chunked, unchunk = self._chunk_fns()
 
             opt_c = chunked(opt_arg) if self.client_opt.persist else opt_arg
 
@@ -426,11 +557,6 @@ class RoundEngine:
                 (opt_c, chunked(cx), chunked(cy), chunked(client_keys),
                  chunked(self.byz_mask), chunked(client_ids)),
             )
-
-            def unchunk(t):
-                return jax.tree_util.tree_map(
-                    lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), t
-                )
 
             updates, losses, top1s = unchunk((updates, losses, top1s))
             if self.client_opt.persist:
@@ -566,6 +692,202 @@ class RoundEngine:
             fault_diag,
             audit_diag,
         )
+
+    def _round_streaming(self, state: RoundState, cx, cy, client_lr, server_lr, key):
+        """One federated round as a chunk SCAN: the per-chunk
+        train+attack+fault body runs under ``lax.scan`` and each sanitized
+        ``[chunk, D]`` slab feeds the aggregator's (and audit monitor's)
+        streaming reduction state — the dense ``[K, D]`` matrix never
+        exists. Output structure matches :meth:`_round_dense` exactly, so
+        ``run_round``/``run_block`` are agnostic to which body compiled.
+        Variance metrics come from running moments (one-pass
+        ``E[x^2]-E[x]^2``); per-round losses/top1s are exact (``[K]``
+        scalars are cheap at any K)."""
+        round_key = rng.key_for_round(key, state.round_idx)
+        client_keys = rng.key_per_client(round_key, self.num_clients)
+        attack_key = jax.random.fold_in(round_key, rng.ATTACK)
+        k = self.num_clients
+        c = self.client_chunks
+
+        if self.plan is not None:
+            cx = lax.with_sharding_constraint(cx, self.plan.clients)
+            cy = lax.with_sharding_constraint(cy, self.plan.clients)
+
+        persist = self.client_opt.persist
+        if persist:
+            in_axes = (None, 0, None, 0, 0, 0, 0, 0)
+            opt_arg = state.client_opt_state
+        else:
+            in_axes = (None, None, None, 0, 0, 0, 0, 0)
+            opt_arg = ()
+        vmapped = jax.vmap(self._local_update, in_axes=in_axes)
+        client_ids = jnp.arange(k, dtype=jnp.int32)
+        chunked, unchunk = self._chunk_fns()
+        # [K]-true / padding-False row validity; chunked() pads with False
+        valid = jnp.ones(k, bool)
+
+        # global [K]-level fault decisions up front (the mask draws are the
+        # cheap part and stay bit-identical to the dense path's); the
+        # row-local payload corruption + non-finite guard apply per chunk
+        fault_diag = {}
+        part0 = valid
+        corrupt = jnp.zeros(k, bool)
+        corrupt_key = round_key  # placeholder; unused without a fault model
+        n_dropped = jnp.asarray(0, jnp.int32)
+        if self.fault_model is not None:
+            fault_key = jax.random.fold_in(round_key, rng.FAULT)
+            part0, drop, corrupt, corrupt_key = self.fault_model.plan_streaming(
+                k, fault_key, state.round_idx
+            )
+            n_dropped = jnp.sum(drop.astype(jnp.int32))
+
+        sctx = dict(
+            params_flat=ravel(state.params),
+            key=jax.random.fold_in(round_key, rng.AGG),
+        )
+        agg_ss = self.aggregator.streaming_init(
+            k, c, self.chunk_size, self.dim, state.agg_state
+        )
+        fb = (
+            self.audit_monitor.fallback_aggregator
+            if self.audit_monitor is not None
+            else None
+        )
+        fb_ss = (
+            fb.streaming_init(k, c, self.chunk_size, self.dim, ())
+            if fb is not None
+            else ()
+        )
+        aud_ss = (
+            self.audit_monitor.streaming_init(k, c, self.chunk_size, self.dim)
+            if self.audit_monitor is not None
+            else ()
+        )
+        zero = jnp.asarray(0, jnp.int32)
+        carry0 = (
+            agg_ss, fb_ss, aud_ss, state.attack_state,
+            moments_init(self.dim), zero, zero,
+        )
+        xs = (
+            chunked(opt_arg) if persist else (),
+            chunked(cx), chunked(cy), chunked(client_keys),
+            chunked(self.byz_mask), chunked(client_ids), chunked(valid),
+            jnp.arange(c, dtype=jnp.int32),
+            chunked(part0), chunked(corrupt),
+        )
+
+        def body(carry, xs_t):
+            agg_ss, fb_ss, aud_ss, att_state, mom, n_part, n_excl = carry
+            o, x, y, ck, byz, ids, val, j, p0, cor = xs_t
+            upd, new_opt, losses, top1s = vmapped(
+                state.params, o if persist else (), client_lr, x, y, ck,
+                byz, ids,
+            )
+            upd = jnp.nan_to_num(upd)
+            if self.plan is not None:
+                # clients-axis constraint only, same rule (and same
+                # miscompile rationale) as the dense body
+                upd = lax.with_sharding_constraint(upd, self.plan.clients)
+            upd, att_state = self.attack.on_updates(
+                upd, byz, jax.random.fold_in(attack_key, j), att_state
+            )
+            # variance metrics accumulate over what the clients SENT
+            # (post-attack, pre-fault) — mirroring the dense body
+            mom = moments_update(mom, upd, val)
+            if self.fault_model is not None:
+                upd = self.fault_model.corrupt_chunk(
+                    upd, cor, jax.random.fold_in(corrupt_key, j)
+                )
+                part_c = p0
+                if self.fault_model.guard_nonfinite:
+                    finite = jnp.all(jnp.isfinite(upd), axis=1)
+                    excl = part_c & ~finite
+                    n_excl = n_excl + jnp.sum(excl.astype(jnp.int32))
+                    part_c = part_c & finite
+            else:
+                part_c = val
+            mask_c, safe = Aggregator._sanitize(upd, part_c)
+            n_part = n_part + jnp.sum(mask_c.astype(jnp.int32))
+            agg_ss = self.aggregator.streaming_update(
+                agg_ss, safe, chunk_mask=mask_c, chunk_index=j, **sctx
+            )
+            if fb is not None:
+                fb_ss = fb.streaming_update(
+                    fb_ss, safe, chunk_mask=mask_c, chunk_index=j, **sctx
+                )
+            if self.audit_monitor is not None:
+                aud_ss = self.audit_monitor.streaming_update(
+                    aud_ss, safe, chunk_mask=mask_c, chunk_index=j
+                )
+            return (
+                (agg_ss, fb_ss, aud_ss, att_state, mom, n_part, n_excl),
+                (new_opt if persist else (), losses, top1s),
+            )
+
+        carry, ys = lax.scan(body, carry0, xs)
+        agg_ss, fb_ss, aud_ss, attack_state, mom, n_part, n_excl = carry
+        new_opt_c, losses_c, top1s_c = ys
+        losses, top1s = unchunk((losses_c, top1s_c))
+        new_client_opt = unchunk(new_opt_c) if persist else ()
+
+        agg, agg_state = self.aggregator.streaming_finalize(
+            agg_ss, state.agg_state, **sctx
+        )
+        # graceful skip: zero participants apply the zero pseudo-gradient
+        agg = jnp.where(n_part > 0, agg, jnp.zeros_like(agg))
+
+        audit_diag = {}
+        if self.audit_monitor is not None:
+            fb_agg = None
+            if fb is not None:
+                fb_agg, _ = fb.streaming_finalize(fb_ss, (), **sctx)
+                fb_agg = jnp.where(n_part > 0, fb_agg, jnp.zeros_like(fb_agg))
+            agg, audit_diag = self.audit_monitor.streaming_apply(
+                aud_ss, agg, fallback_agg=fb_agg
+            )
+
+        fault_state = state.fault_state
+        if self.fault_model is not None:
+            fault_diag = {
+                "participants": n_part,
+                "dropped": n_dropped,
+                "stale_replayed": zero,
+                "stragglers_expired": zero,
+                "corrupted": jnp.sum(corrupt.astype(jnp.int32)),
+                "excluded_nonfinite": n_excl,
+            }
+
+        # server pseudo-gradient step + metrics: same tail as the dense body
+        grad_tree = self.unravel(-agg)
+        server_updates, server_opt_state = self._server_tx.update(
+            grad_tree, state.server_opt_state, state.params
+        )
+        params = jax.tree_util.tree_map(
+            lambda p, u: p - server_lr * u.astype(p.dtype),
+            state.params,
+            server_updates,
+        )
+        honest = (~self.byz_mask).astype(losses.dtype)
+        n_honest = jnp.maximum(honest.sum(), 1.0)
+        var = moments_var(mom)
+        metrics = RoundMetrics(
+            train_loss=(losses * honest).sum() / n_honest,
+            train_loss_all=losses.mean(),
+            train_top1=(top1s * honest).sum() / n_honest,
+            update_variance=var.mean(),
+            update_variance_norm=jnp.linalg.norm(var),
+            agg_norm=jnp.linalg.norm(agg),
+        )
+        new_state = RoundState(
+            params=params,
+            server_opt_state=server_opt_state,
+            client_opt_state=new_client_opt,
+            agg_state=agg_state,
+            attack_state=attack_state,
+            round_idx=state.round_idx + 1,
+            fault_state=fault_state,
+        )
+        return new_state, metrics, (), {}, fault_diag, audit_diag
 
     def run_round(
         self,

@@ -190,6 +190,64 @@ class FaultModel:
         }
         return out, part, new_state, diag
 
+    # -- streaming (chunk-scanned) fault pass ---------------------------------
+
+    def plan_streaming(
+        self, num_clients: int, key: jax.Array, round_idx
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jax.Array]:
+        """[K]-level fault decisions for the chunk-scanned round
+        (``core/engine.py`` with ``streaming=True``): returns
+        ``(participation, dropped, corrupt, corrupt_key)``. The mask draws
+        split the round key exactly like :meth:`apply`, so dropout /
+        schedule / corruption-victim decisions are bit-identical to the
+        dense path's; only the BITFLIP payload noise differs (it is drawn
+        per chunk from ``fold_in(corrupt_key, chunk_index)`` inside
+        :meth:`corrupt_chunk` rather than as one ``[K, D]`` draw).
+        Stragglers are a dense-only feature — their replay buffer is
+        ``[K, D]`` state, the memory the streaming engine exists to avoid.
+        """
+        if self.has_stragglers:
+            raise ValueError(
+                "straggler replay buffers are [K, D] state; the streaming "
+                "round supports participation/corruption faults only"
+            )
+        k = num_clients
+        kd, ks, kc, kb = jax.random.split(key, 4)
+        del ks  # the straggler stream, reserved to keep draw parity
+
+        if self.participation_schedule is not None:
+            sched = jnp.asarray(self.participation_schedule)
+            drop = ~sched[jnp.mod(round_idx, sched.shape[0])]
+        elif self.dropout_rate > 0.0:
+            drop = jax.random.bernoulli(kd, self.dropout_rate, (k,))
+        else:
+            drop = jnp.zeros((k,), bool)
+        part = ~drop
+
+        corrupt = jnp.zeros((k,), bool)
+        if self.corrupt_rate > 0.0:
+            corrupt |= jax.random.bernoulli(kc, self.corrupt_rate, (k,))
+        if self.corrupt_clients:
+            ids = jnp.asarray(self.corrupt_clients, jnp.int32)
+            corrupt |= jnp.any(
+                jnp.arange(k, dtype=jnp.int32)[:, None] == ids[None, :], axis=1
+            )
+        corrupt &= part  # only delivered payloads can arrive corrupted
+        return part, drop, corrupt, kb
+
+    def corrupt_chunk(
+        self, slab: jnp.ndarray, corrupt: jnp.ndarray, key: jax.Array
+    ) -> jnp.ndarray:
+        """Row-local payload corruption for one ``[chunk, D]`` slab
+        (``corrupt`` is the chunk's slice of the planned victim mask)."""
+        if self.corrupt_mode == "nan":
+            return jnp.where(corrupt[:, None], jnp.nan, slab)
+        if self.corrupt_mode == "inf":
+            return jnp.where(corrupt[:, None], jnp.inf, slab)
+        flip = jax.random.bernoulli(key, self.bitflip_frac, slab.shape)
+        flipped = jnp.where(flip, -self.bitflip_scale * slab, slab)
+        return jnp.where(corrupt[:, None], flipped, slab)
+
     def __repr__(self) -> str:
         parts = []
         if self.participation_schedule is not None:

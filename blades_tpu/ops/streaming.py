@@ -1,0 +1,160 @@
+"""Streaming (chunk-scanned) reductions over the client axis.
+
+The dense engine materializes the full ``[K, D]`` post-attack update matrix
+before aggregating — which caps K at device memory regardless of how the
+*training* activations are chunked. This module holds the building blocks
+of the streaming alternative: the engine ``lax.scan``\\s the per-chunk
+train+attack+fault body and feeds each ``[chunk, D]`` slab into a small
+**running reduction state**, so peak update memory is ``[chunk, D]`` (plus
+``[num_chunks, ...]`` chunk summaries) independent of K.
+
+Three families of primitives:
+
+- **running moments** — mask-aware count / sum / sum-of-squares carries for
+  streaming means and the engine's per-coordinate variance metrics
+  (one-pass ``E[x^2] - E[x]^2``, clamped at zero);
+- **chunk stacks** — fixed-shape ``[num_chunks, ...]`` accumulators written
+  one chunk-local summary per scan step (``lax.dynamic_update_index_in_dim``),
+  the carrier of every *two-level* aggregate ("aggregate the
+  chunk-aggregates", ``aggregators/base.py``);
+- **chunk geometry sketches** — per-chunk center / radius / diameter and
+  per-row distance-to-chunk-center scalars, from which the streaming
+  :class:`~blades_tpu.audit.monitor.AuditMonitor` certificates derive
+  triangle-inequality interval bounds on the dense row statistics
+  (``|u_i - p| ∈ d_i ± |c_j - p|`` for any point ``p`` fixed at finalize).
+
+Everything is a pure fixed-shape function (jit/scan-safe); masks follow the
+``ops/masked.py`` discipline — masked-out rows enter sums only as exact
+identities, so an all-ones mask reproduces the unmasked arithmetic
+bit-exactly.
+
+Reference counterpart: none — the reference aggregates host-side lists of
+full update vectors (``src/blades/aggregators/mean.py:21-28``); its client
+axis is capped by driver RAM long before 10^4. The chunk-the-batch-axis
+discipline follows the hybrid-sharding exemplars in SNIPPETS.md, applied to
+the client axis instead of the data axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+from jax import lax
+
+
+# -- chunk layout -------------------------------------------------------------
+
+
+def chunk_layout(num_rows: int, num_chunks: int):
+    """``(num_chunks, chunk_size, pad)`` for the padded chunk layout.
+
+    Single owner of the layout rule shared by the engine
+    (``RoundEngine.__init__``), the host-side protocol driver
+    (``Aggregator.aggregate_streaming``) and the streaming tests: the
+    chunk count clamps to the population, chunks are ceil-sized, and the
+    count is renormalized against the ceil size so no chunk is 100%
+    padding (``pad < chunk_size`` always).
+    """
+    c = max(1, min(int(num_chunks), int(num_rows)))
+    chunk = -(-int(num_rows) // c)
+    c = -(-int(num_rows) // chunk)
+    return c, chunk, c * chunk - int(num_rows)
+
+
+# -- running moments ----------------------------------------------------------
+
+
+def moments_init(dim: int, dtype=jnp.float32) -> Dict[str, Any]:
+    """Zero running-moment carry for a ``[*, dim]`` stream."""
+    return {
+        "sum": jnp.zeros((dim,), dtype),
+        "sumsq": jnp.zeros((dim,), dtype),
+        "count": jnp.zeros((), dtype),
+    }
+
+
+def moments_update(
+    m: Dict[str, Any], rows: jnp.ndarray, mask: jnp.ndarray
+) -> Dict[str, Any]:
+    """Fold a ``[chunk, D]`` slab into the carry (masked rows contribute 0)."""
+    w = mask.astype(rows.dtype)[:, None]
+    return {
+        "sum": m["sum"] + jnp.sum(rows * w, axis=0),
+        "sumsq": m["sumsq"] + jnp.sum(rows * rows * w, axis=0),
+        "count": m["count"] + jnp.sum(mask.astype(m["count"].dtype)),
+    }
+
+
+def moments_mean(m: Dict[str, Any]) -> jnp.ndarray:
+    """Streaming mean; zero vector when the stream was empty."""
+    return m["sum"] / jnp.maximum(m["count"], 1.0)
+
+
+def moments_var(m: Dict[str, Any]) -> jnp.ndarray:
+    """One-pass population variance ``E[x^2] - E[x]^2`` per coordinate.
+
+    Numerically this is the textbook one-pass form (catastrophic
+    cancellation possible when ``|mean| >> std``), clamped at zero — it
+    feeds *metrics* (``update_variance`` telemetry), never defense
+    arithmetic, and the documented streaming-metrics tolerance covers it.
+    """
+    mu = moments_mean(m)
+    return jnp.maximum(m["sumsq"] / jnp.maximum(m["count"], 1.0) - mu * mu, 0.0)
+
+
+# -- chunk stacks -------------------------------------------------------------
+
+
+def stack_init(num_chunks: int, shape, dtype=jnp.float32) -> jnp.ndarray:
+    """Zero ``[num_chunks, *shape]`` accumulator for per-chunk summaries."""
+    return jnp.zeros((num_chunks,) + tuple(shape), dtype)
+
+
+def stack_write(stack: jnp.ndarray, chunk_index, value: jnp.ndarray) -> jnp.ndarray:
+    """Write one chunk's summary at a traced index (scan-carry friendly)."""
+    return lax.dynamic_update_index_in_dim(
+        stack, value.astype(stack.dtype), chunk_index, axis=0
+    )
+
+
+def weighted_stack_mean(stack: jnp.ndarray, counts: jnp.ndarray) -> jnp.ndarray:
+    """Count-weighted mean of chunk summaries: ``sum_j n_j a_j / sum_j n_j``.
+
+    The exact recombination for any chunk summary that is itself a
+    participant mean (``mean == weighted mean of chunk means``); zero vector
+    when no chunk had participants.
+    """
+    w = counts.astype(stack.dtype)
+    num = jnp.sum(stack * w[:, None], axis=0)
+    return num / jnp.maximum(jnp.sum(w), 1.0)
+
+
+# -- chunk geometry sketches --------------------------------------------------
+
+
+def chunk_geometry(
+    slab: jnp.ndarray, mask: jnp.ndarray, center: jnp.ndarray
+) -> Dict[str, Any]:
+    """Per-chunk geometry summary against a chunk-local ``center``.
+
+    Returns ``row_dist [chunk]`` (distance of each participating row to the
+    center; 0 for masked rows), ``radius`` (max row distance) and
+    ``diameter`` (exact max pairwise distance *within* the chunk — a
+    ``[chunk, chunk]`` matrix, cheap at chunk scale). These are the
+    sufficient statistics for the streaming audit certificates'
+    triangle-inequality bounds.
+    """
+    diff = slab - center[None, :]
+    d = jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=1), 0.0))
+    d = jnp.where(mask, d, 0.0)
+    # within-chunk pairwise distances (chunk^2 — small by construction)
+    sq = jnp.sum(slab * slab, axis=1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (slab @ slab.T)
+    pair = mask[:, None] & mask[None, :]
+    diam = jnp.sqrt(jnp.maximum(jnp.max(jnp.where(pair, d2, 0.0)), 0.0))
+    return {
+        "row_dist": d,
+        "radius": jnp.max(d),
+        "diameter": diam,
+    }

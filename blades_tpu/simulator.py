@@ -315,6 +315,7 @@ class Simulator:
         fault_model: Optional[Union[FaultModel, Dict]] = None,
         audit_monitor: Optional[Union[AuditMonitor, Dict]] = None,
         block_size: int = 1,
+        streaming: bool = False,
     ) -> List[float]:
         """Run adversarial training; returns per-round wall times (reference
         ``run`` contract, ``simulator.py:364-457``).
@@ -373,6 +374,19 @@ class Simulator:
         Falls back to per-round execution (with a debug note) when
         ``retain_updates``/``on_round_end`` need per-round host visibility
         or the dataset has no traceable sampler.
+        ``streaming``: chunk-SCAN the round (``RoundEngine`` with
+        ``streaming=True``) — the aggregation consumes ``[chunk, D]``
+        update slabs through the registry's streaming reduction protocol
+        and the dense ``[K, D]`` matrix is never materialized, so peak
+        update memory is ``client_chunks``-independent of K (the large-K
+        regime; see docs/performance.md "Memory scaling"). Composes with
+        ``block_size`` and with mask/corruption fault models; incompatible
+        with ``retain_updates``/``on_round_end`` (they read the matrix
+        streaming never builds — raises) and with aggregators/attacks
+        documented as dense-only (the engine raises at build, naming the
+        reason). Per-run ``engine.peak_update_bytes`` /
+        ``engine.client_chunks`` / ``engine.chunk_size`` /
+        ``engine.streaming`` gauges ride every telemetry round record.
 
         Telemetry (``docs/observability.md``): unless ``BLADES_TELEMETRY=0``,
         a span/counter trace of the run is appended to
@@ -406,6 +420,14 @@ class Simulator:
             fault_model = FaultModel(**fault_model)
         if isinstance(audit_monitor, dict):
             audit_monitor = AuditMonitor(**audit_monitor)
+        # validate BEFORE any process-wide state changes below (the
+        # supervised SIGTERM handler install): a config error must raise
+        # clean, not leak a signal handler to a caller that catches it
+        if streaming and (retain_updates or on_round_end is not None):
+            raise ValueError(
+                "streaming=True never materializes the [K, D] update matrix "
+                "that retain_updates/on_round_end read; run dense for those"
+            )
         trace_path = os.path.join(self.log_path, "telemetry.jsonl")
         # the log-dir wipe preserves the trace for kill -> relaunch
         # post-mortems, but a FRESH unsupervised run is a NEW experiment:
@@ -447,23 +469,6 @@ class Simulator:
         # — the documented tunnel-hang scenario — must still leave a trace
         # to post-mortem, not depend on surviving to the first round flush
         rec.flush()
-        # supervised runs: SIGTERM (the supervisor's first escalation step)
-        # becomes an in-loop exception so the crash autosave below fires
-        # before SIGKILL; main-thread only (signal.signal's constraint)
-        prev_sigterm = None
-        if (
-            os.environ.get(_heartbeat.SUPERVISED_ENV) == "1"
-            and threading.current_thread() is threading.main_thread()
-        ):
-            def _on_sigterm(signum, frame):
-                raise SupervisorTermination(
-                    "SIGTERM from run supervisor"
-                )
-
-            try:
-                prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
-            except (ValueError, OSError):
-                prev_sigterm = None
         spec = self._model_spec(model, loss, compute_dtype)
         batch_size = train_batch_size or self._train_bs
 
@@ -502,7 +507,36 @@ class Simulator:
             collect_diagnostics=collect_diagnostics,
             fault_model=fault_model,
             audit_monitor=audit_monitor,
+            streaming=streaming,
         )
+        # memory observability: the round program's peak update-matrix
+        # footprint rides every round record as gauges (streaming rounds
+        # must show [chunk, D], dense rounds [K, D] — trace_summary.py
+        # surfaces the max, so a regression to dense peaks is visible)
+        rec.gauge("engine.peak_update_bytes", self.engine.peak_update_bytes)
+        rec.gauge("engine.client_chunks", self.engine.client_chunks)
+        rec.gauge("engine.chunk_size", self.engine.chunk_size)
+        rec.gauge("engine.streaming", int(self.engine.streaming))
+        # supervised runs: SIGTERM (the supervisor's first escalation step)
+        # becomes an in-loop exception so the crash autosave below fires
+        # before SIGKILL; main-thread only (signal.signal's constraint).
+        # Installed only AFTER every config-validation error can have
+        # raised (this call + RoundEngine construction above): a build-time
+        # ValueError must never leak the handler process-wide.
+        prev_sigterm = None
+        if (
+            os.environ.get(_heartbeat.SUPERVISED_ENV) == "1"
+            and threading.current_thread() is threading.main_thread()
+        ):
+            def _on_sigterm(signum, frame):
+                raise SupervisorTermination(
+                    "SIGTERM from run supervisor"
+                )
+
+            try:
+                prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+            except (ValueError, OSError):
+                prev_sigterm = None
         state = self.engine.init(params)
 
         # crash-autosave target: the explicit checkpoint path when given,

@@ -63,6 +63,8 @@ GALLERY = [
      ["--rounds", "2", "--out", "@TMP@", "--aggs", "median"], {}, 900),
     ("defense_audit.py", ["--rounds", "2", "--out", "@TMP@"], {}, 900),
     ("supervised_run.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
+    ("streaming_clients.py",
+     ["--rounds", "2", "--clients", "12", "--out", "@TMP@"], {}, 900),
     ("fedavg_ipm.py",
      ["--rounds", "2", "--steps", "2", "--out", "@TMP@"], {}, 900),
     ("robustness_matrix.py",
@@ -93,6 +95,7 @@ API_MODULES = [
     "blades_tpu.models",
     "blades_tpu.models.pretrained",
     "blades_tpu.ops.ring_attention",
+    "blades_tpu.ops.streaming",
     "blades_tpu.ops.ulysses",
     "blades_tpu.parallel.mesh",
     "blades_tpu.parallel.distributed",

@@ -1,0 +1,155 @@
+"""K-scaling evidence for the streaming client axis (results/streaming_k/).
+
+Measures, through the real bench child (bench.py: device-side sampling,
+vmapped local training, in-graph aggregation, server step), the memory
+claim of the streaming refactor: peak update memory is ``[chunk, D]``
+independent of K, so K scales to 10^4-10^5 where the dense ``[K, D]`` path
+is unrunnable.
+
+Protocol (single virtual CPU device, per CLAUDE.md's partitioner caveat):
+
+1. **overhead pair @ K=1000** (uncapped): dense vs streaming trimmed-mean,
+   same config — the throughput cost of streaming at a K both paths run;
+2. **capped pair @ K=10^4** (16 GiB address-space cap ~ a v5e chip's HBM):
+   the dense path must materialize the [10^4, 206k] fp32 matrix (~8.3 GB)
+   plus the trimmed-mean sort temporaries on top of training state — it
+   dies under the cap; the streaming path runs the SAME workload in
+   [100, 206k] slabs (~83 MB peak update memory) and completes;
+3. **stretch row @ K=10^5** (32 GiB cap): streaming mean — the dense
+   matrix alone would be ~83 GB, beyond even this host's 136 GB once the
+   aggregation temporaries double it.
+
+Every row records the child payload's self-describing layout fields
+(client_chunks / chunk_size / streaming / peak_update_bytes). Output:
+results/streaming_k/rows.jsonl + README.md.
+"""
+import datetime
+import json
+import os
+import resource
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "results", "streaming_k")
+os.makedirs(OUT, exist_ok=True)
+ROWS = os.path.join(OUT, "rows.jsonl")
+
+GIB = 1024 ** 3
+
+COMMON = {
+    "BENCH_CHILD": 1,
+    "BENCH_FORCE_CPU": 1,
+    # ONE virtual device (see scripts/baseline_rows_cpu.py: the 8-device
+    # SPMD partitioner compile is the >40-min pathology; these rows prove
+    # the memory model, not the sharding)
+    "BENCH_CPU_DEVICES": 1,
+    "BENCH_MODEL": "mlp",        # D ~ 206k: [K, D] fp32 is 8.3 GB at K=1e4
+    "BENCH_AGG": "trimmedmean",  # the headline defense, two-level streaming
+    "BENCH_REMAT": 0,
+    "BENCH_BF16": 0,
+    "BENCH_SAMPLES": 8,          # per-client shard: data axis stays modest
+    "BENCH_BATCH": 2,
+    "BENCH_WARMUP": 1,
+    "BENCH_TIMED": 2,
+}
+
+
+def child_row(name, timeout=2400, mem_cap_gib=None, **env):
+    full_env = dict(os.environ)
+    full_env.pop("XLA_FLAGS", None)  # same rationale as baseline_rows_cpu
+    full_env.update({k: str(v) for k, v in {**COMMON, **env}.items()})
+    preexec = None
+    if mem_cap_gib is not None:
+        cap = int(mem_cap_gib * GIB)
+
+        def preexec():  # noqa: E731 - runs in the child pre-exec
+            resource.setrlimit(resource.RLIMIT_AS, (cap, cap))
+
+    print(f"[streaming_k] {name}: cap={mem_cap_gib}GiB {env}", flush=True)
+    row = {"name": name, "env": {k: str(v) for k, v in env.items()},
+           "mem_cap_gib": mem_cap_gib}
+    try:
+        p = subprocess.run(
+            [sys.executable, "bench.py"], cwd=REPO, env=full_env,
+            capture_output=True, text=True, timeout=timeout,
+            preexec_fn=preexec,
+        )
+        for line in p.stdout.splitlines():
+            if line.startswith("BENCH_CHILD_RESULT "):
+                row.update(json.loads(line[len("BENCH_CHILD_RESULT "):]))
+        if "rounds_per_sec" not in row and "error" not in row:
+            row["error"] = (
+                f"rc={p.returncode}: "
+                + (p.stderr or "no result line").strip()[-400:]
+            )
+    except subprocess.TimeoutExpired:
+        row["error"] = f"timeout after {timeout}s"
+    row["date"] = datetime.datetime.utcnow().isoformat()
+    with open(ROWS, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(
+        f"[streaming_k] {name} -> "
+        f"{row.get('rounds_per_sec', row.get('error', ''))!r} "
+        f"peak_update_bytes={row.get('peak_update_bytes')}",
+        flush=True,
+    )
+    return row
+
+
+def main():
+    if os.path.exists(ROWS):
+        os.unlink(ROWS)
+
+    # 1. overhead pair at a K both paths run (uncapped)
+    r_dense = child_row(
+        "k1000_dense_trimmedmean",
+        BENCH_CLIENTS=1000, BENCH_CHUNKS=10, BENCH_STREAMING=0,
+    )
+    r_stream = child_row(
+        "k1000_streaming_trimmedmean",
+        BENCH_CLIENTS=1000, BENCH_CHUNKS=10, BENCH_STREAMING=1,
+    )
+    if "rounds_per_sec" in r_dense and "rounds_per_sec" in r_stream:
+        with open(ROWS, "a") as f:
+            f.write(json.dumps({
+                "name": "k1000_streaming_vs_dense",
+                "dense_rps": r_dense["rounds_per_sec"],
+                "streaming_rps": r_stream["rounds_per_sec"],
+                "streaming_overhead": round(
+                    r_dense["rounds_per_sec"] / r_stream["rounds_per_sec"], 3
+                ),
+                "dense_peak_update_bytes": r_dense.get("peak_update_bytes"),
+                "streaming_peak_update_bytes":
+                    r_stream.get("peak_update_bytes"),
+                "date": datetime.datetime.utcnow().isoformat(),
+            }) + "\n")
+
+    # 2. the capped pair at K=10^4: dense dies, streaming completes
+    child_row(
+        "k10000_dense_attempt_16gib",
+        timeout=1800, mem_cap_gib=16,
+        BENCH_CLIENTS=10000, BENCH_CHUNKS=100, BENCH_STREAMING=0,
+        BENCH_BATCH=1, BENCH_TIMED=1,
+    )
+    child_row(
+        "k10000_streaming_16gib",
+        timeout=3600, mem_cap_gib=16,
+        BENCH_CLIENTS=10000, BENCH_CHUNKS=100, BENCH_STREAMING=1,
+        BENCH_BATCH=1, BENCH_TIMED=1,
+    )
+
+    # 3. stretch: K=10^5 streaming (mean — exact streaming form; the
+    # two-level sort cost at 1e5 is a perf item, not a memory one)
+    child_row(
+        "k100000_streaming_mean_32gib",
+        timeout=5400, mem_cap_gib=32,
+        BENCH_CLIENTS=100000, BENCH_CHUNKS=100, BENCH_STREAMING=1,
+        BENCH_AGG="mean", BENCH_BATCH=1, BENCH_WARMUP=1, BENCH_TIMED=1,
+    )
+
+    print(f"[streaming_k] rows -> {ROWS}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -141,10 +141,35 @@ def summarize(records: List[dict]) -> dict:
             },
         }
 
+    # per-round peak update-matrix bytes (engine.* gauges ride every round
+    # record): surfaces streaming-vs-dense memory regressions in traces —
+    # a round whose peak grew back to [K, D] is a bug, not noise
+    memory_summary: Dict[str, float] = {}
+    peak_vals = [
+        r["gauges"]["engine.peak_update_bytes"]
+        for r in rounds
+        if "engine.peak_update_bytes" in (r.get("gauges") or {})
+    ]
+    if peak_vals:
+        memory_summary["peak_update_bytes"] = max(peak_vals)
+        last_gauges = next(
+            (
+                r["gauges"]
+                for r in reversed(rounds)
+                if "engine.peak_update_bytes" in (r.get("gauges") or {})
+            ),
+            {},
+        )
+        for key in ("engine.streaming", "engine.client_chunks",
+                    "engine.chunk_size"):
+            if key in last_gauges:
+                memory_summary[key.split(".", 1)[1]] = last_gauges[key]
+
     return {
         "meta": meta,
         "spans": spans,
         "counters": counters,
+        "memory": memory_summary,
         "block": block_summary,
         "rounds": {
             "count": len(rounds),
@@ -218,6 +243,18 @@ def format_table(summary: dict) -> str:
             for k, v in sorted(summary["counters"].items())
         )
         lines.append(f"counters: {pairs}")
+    mem = summary.get("memory") or {}
+    if mem:
+        mb = mem["peak_update_bytes"] / 1e6
+        extras = ", ".join(
+            f"{k}={int(mem[k])}"
+            for k in ("streaming", "client_chunks", "chunk_size")
+            if k in mem
+        )
+        lines.append(
+            f"memory: peak_update_bytes={mem['peak_update_bytes']:.0f} "
+            f"({mb:.1f} MB{', ' + extras if extras else ''})"
+        )
     if summary["defense"]:
         pairs = ", ".join(
             f"{k}={v:.3f}" for k, v in sorted(summary["defense"].items())

@@ -1,0 +1,568 @@
+"""Streaming (chunk-scanned) aggregation tests — the contracts behind
+``RoundEngine(streaming=True)`` and the ``Aggregator.streaming_*`` protocol:
+
+1. **Registry lint** — every registered aggregator either implements the
+   streaming protocol or documents WHY it cannot (``streaming_optouts``);
+   a new defense cannot silently ship without a position on large-K.
+2. **Parity** — exact-form aggregators (``streaming_exact``) reproduce the
+   dense estimator across chunk counts {1, 2, K} up to floating-point
+   re-association of the chunk partial sums; two-level forms stay inside
+   the participants' per-coordinate envelope and within the update
+   diameter of the dense result (their documented bound), and collapse to
+   the dense result on concentrated honest updates.
+3. **Mask semantics** — a masked-out row's payload is inert bit-exactly
+   (NaN/Inf/1e30 garbage), matching the dense mask-API contract.
+4. **Engine equivalence** — the streaming round program matches the dense
+   round (mean: tight; robust: documented tolerance), composes with the
+   padded final chunk, fault masks, audit monitor + streaming fallback,
+   and ``run_block`` (block-of-streaming-rounds bit-exact vs sequential).
+5. **Streaming audit certificates** — singleton chunks reproduce the dense
+   certificates exactly; interval bounds bracket the dense statistics.
+
+Reference counterpart: none — the reference's client axis is a host-side
+Python list (``src/blades/aggregators/mean.py:21-28``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.aggregators import AGGREGATORS, get_aggregator
+from blades_tpu.attackers import get_attack
+from blades_tpu.audit.monitor import AuditMonitor
+from blades_tpu.core import ClientOptSpec, RoundEngine
+from blades_tpu.faults import FaultModel
+from blades_tpu.ops.pytree import ravel
+
+K, D = 12, 7
+
+
+def _agg(name):
+    kw = {"num_byzantine": 2} if name in (
+        "trimmedmean", "krum", "multikrum", "dnc"
+    ) else {}
+    return get_aggregator(name, **kw)
+
+
+def _ctx(name, k=K, d=D):
+    if name == "dnc":
+        return {"key": jax.random.key(3)}
+    if name == "byzantinesgd":
+        return {"params_flat": jnp.zeros(d)}
+    if name == "fltrust":
+        return {"trusted_mask": jnp.zeros(k, bool).at[3].set(True)}
+    return {}
+
+
+def rand_updates(seed=0, k=K, d=D):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(k, d)).astype(np.float32)
+
+
+STREAMING = sorted(
+    n for n in AGGREGATORS if _agg(n).supports_streaming()
+)
+EXACT = sorted(n for n in STREAMING if _agg(n).streaming_exact)
+TWO_LEVEL = sorted(n for n in STREAMING if not _agg(n).streaming_exact)
+
+
+# ------------------------------------------------------------ registry lint
+
+
+@pytest.mark.parametrize("name", sorted(AGGREGATORS))
+def test_registry_streaming_lint(name):
+    """CI lint: streaming path implemented OR a documented opt-out reason —
+    the large-K story of every registered defense is explicit."""
+    agg = _agg(name)
+    if agg.supports_streaming():
+        return
+    reason = agg.streaming_optouts.get("streaming")
+    assert isinstance(reason, str) and len(reason) > 20, (
+        f"{name} neither implements streaming aggregation nor documents "
+        "a streaming_optouts reason"
+    )
+
+
+def test_streaming_coverage_is_what_we_think():
+    """13 streaming defenses / 3 documented dense-only holdouts — this
+    pins the split so a regression (an aggregator silently dropping its
+    streaming form) shows up as a diff here, not as a silent opt-out."""
+    assert set(AGGREGATORS) - set(STREAMING) == {
+        "fltrust", "byzantinesgd", "dnc"
+    }
+
+
+# ------------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize("chunks", [1, 2, K])
+@pytest.mark.parametrize("name", EXACT)
+def test_exact_streaming_matches_dense(name, chunks):
+    """Exact-form aggregators produce the dense estimator: any deviation is
+    floating-point re-association of chunk partial sums (machine-epsilon
+    scale), never an approximation."""
+    u = jnp.asarray(rand_updates(seed=1))
+    a = _agg(name)
+    dense, _ = a.aggregate(u, a.init_state(K, D), **_ctx(name))
+    got, _ = a.aggregate_streaming(
+        u, a.init_state(K, D), num_chunks=chunks, **_ctx(name)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(dense), rtol=1e-5, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("chunks", [2, 3, K])
+@pytest.mark.parametrize("name", TWO_LEVEL)
+def test_two_level_streaming_bounds(name, chunks):
+    """The documented two-level bound: the streaming aggregate stays inside
+    the participants' per-coordinate envelope (union with 0 for the
+    clipping/filter family, whose members shrink rows toward the origin),
+    and within the update diameter of the dense result."""
+    u = rand_updates(seed=2)
+    a = _agg(name)
+    dense, _ = a.aggregate(jnp.asarray(u), a.init_state(K, D), **_ctx(name))
+    got, _ = a.aggregate_streaming(
+        jnp.asarray(u), a.init_state(K, D), num_chunks=chunks, **_ctx(name)
+    )
+    got = np.asarray(got)
+    assert np.isfinite(got).all()
+    lo = np.minimum(u.min(axis=0), 0.0) - 1e-5
+    hi = np.maximum(u.max(axis=0), 0.0) + 1e-5
+    assert (got >= lo).all() and (got <= hi).all(), (
+        f"{name}: two-level result left the participants' envelope"
+    )
+    diam = np.sqrt(
+        ((u[:, None, :] - u[None, :, :]) ** 2).sum(-1)
+    ).max()
+    assert np.linalg.norm(got - np.asarray(dense)) <= diam + 1e-5
+
+
+@pytest.mark.parametrize("name", STREAMING)
+def test_streaming_concentrated_matches_dense(name):
+    """On concentrated honest updates (spread << scale) every streaming
+    form — exact or two-level — agrees with the dense path to the update
+    diameter: the error of 'aggregate the chunk-aggregates' is bounded by
+    the honest spread, so it vanishes exactly when defenses matter least."""
+    rng = np.random.default_rng(5)
+    mu = rng.normal(size=(1, D)).astype(np.float32)
+    u = mu + 0.01 * rng.normal(size=(K, D)).astype(np.float32)
+    a = _agg(name)
+    dense, _ = a.aggregate(jnp.asarray(u), a.init_state(K, D), **_ctx(name))
+    got, _ = a.aggregate_streaming(
+        jnp.asarray(u), a.init_state(K, D), num_chunks=3, **_ctx(name)
+    )
+    diam = np.sqrt(((u[:, None, :] - u[None, :, :]) ** 2).sum(-1)).max()
+    assert np.linalg.norm(np.asarray(got) - np.asarray(dense)) <= diam + 1e-6
+
+
+def test_clippedclustering_ring_ingests_exactly_k_per_round():
+    """The norm-history ring advances by exactly K entries per streaming
+    round — the padded final chunk's zero rows write no phantom history
+    (K=10 @ 4 chunks of 3: pad 2), matching the dense path's write count."""
+    u = jnp.asarray(rand_updates(seed=12, k=10))
+    a = get_aggregator("clippedclustering")
+    _, new_state = a.aggregate_streaming(u, a.init_state(10, D), num_chunks=4)
+    assert int(new_state["count"]) == 10
+    assert int(new_state["pos"]) == 10
+
+
+def test_chunk_count_clamps_to_population():
+    """num_chunks > K clamps to K (singleton chunks) instead of dying."""
+    u = jnp.asarray(rand_updates(seed=3))
+    a = _agg("median")
+    big, _ = a.aggregate_streaming(u, num_chunks=50)
+    ref, _ = a.aggregate_streaming(u, num_chunks=K)
+    np.testing.assert_array_equal(np.asarray(big), np.asarray(ref))
+
+
+def test_streaming_stateful_rounds_advance_state():
+    """Centered clipping's momentum threads through streaming rounds: two
+    streaming rounds (n_iter=1, the exact regime) track two dense rounds."""
+    a = get_aggregator("centeredclipping", n_iter=1)
+    b = get_aggregator("centeredclipping", n_iter=1)
+    st_a, st_b = a.init_state(K, D), b.init_state(K, D)
+    for seed in (7, 8):
+        u = jnp.asarray(rand_updates(seed=seed))
+        dense, st_a = a.aggregate(u, st_a)
+        got, st_b = b.aggregate_streaming(u, st_b, num_chunks=3)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(dense), rtol=1e-5, atol=1e-6
+        )
+    np.testing.assert_allclose(
+        np.asarray(st_b), np.asarray(st_a), rtol=1e-5, atol=1e-6
+    )
+
+
+# ---------------------------------------------------------- mask semantics
+
+
+@pytest.mark.parametrize("garbage", [np.nan, np.inf, 1e30])
+@pytest.mark.parametrize("name", STREAMING)
+def test_streaming_masked_out_rows_inert(name, garbage):
+    """Masked-out payloads cannot change the streaming result in any bit —
+    the slabs are sanitized before any reduction, same rule as the dense
+    mask API (tests/test_faults.py)."""
+    base = rand_updates(seed=4)
+    mask = jnp.asarray([True] * 7 + [False] * 5)
+    poisoned = base.copy()
+    poisoned[7:] = garbage
+
+    a_ref = _agg(name)
+    ref, _ = a_ref.aggregate_streaming(
+        jnp.asarray(base), a_ref.init_state(K, D), num_chunks=3, mask=mask,
+        **_ctx(name),
+    )
+    a_poi = _agg(name)
+    got, _ = a_poi.aggregate_streaming(
+        jnp.asarray(poisoned), a_poi.init_state(K, D), num_chunks=3,
+        mask=mask, **_ctx(name),
+    )
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    assert np.isfinite(np.asarray(got)).all()
+
+
+@pytest.mark.parametrize("name", STREAMING)
+def test_streaming_zero_participants_finite(name):
+    """An all-masked stream still finalizes to a finite vector (the engine
+    additionally zeroes it — graceful skip)."""
+    u = jnp.asarray(rand_updates(seed=6))
+    a = _agg(name)
+    got, _ = a.aggregate_streaming(
+        u, a.init_state(K, D), num_chunks=3, mask=jnp.zeros(K, bool),
+        **_ctx(name),
+    )
+    assert np.isfinite(np.asarray(got)).all()
+
+
+# ------------------------------------------------------- engine equivalence
+
+
+BLOCK_K, BLOCK_F, BLOCK_C = 6, 12, 4
+
+
+def _tiny_loss(p, x, y, key):
+    logits = x.reshape(x.shape[0], -1) @ p["w"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+    top1 = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+    return loss, {"top1": top1}
+
+
+def _tiny_logits(p, x):
+    return x.reshape(x.shape[0], -1) @ p["w"]
+
+
+def _tiny_fixture(k=BLOCK_K, seed=0):
+    from blades_tpu.datasets.fl import FLDataset
+
+    rng = np.random.RandomState(seed)
+    ds = FLDataset(
+        rng.randn(k, 20, BLOCK_F).astype(np.float32),
+        rng.randint(0, BLOCK_C, (k, 20)).astype(np.int32),
+        np.full(k, 20, np.int32),
+        rng.randn(30, BLOCK_F).astype(np.float32),
+        rng.randint(0, BLOCK_C, 30).astype(np.int32),
+    )
+    W0 = {"w": jnp.asarray(rng.randn(BLOCK_F, BLOCK_C).astype(np.float32) * 0.1)}
+    return ds, W0
+
+
+def _tiny_engine(W0, k=BLOCK_K, **kw):
+    defaults = dict(num_clients=k, num_classes=BLOCK_C,
+                    aggregator=get_aggregator("mean"))
+    defaults.update(kw)
+    return RoundEngine(_tiny_loss, _tiny_logits, W0, **defaults)
+
+
+def _one_round(eng, ds, W0, rounds=1):
+    st = eng.init(W0)
+    key = jax.random.PRNGKey(7)
+    for r in range(rounds):
+        cx, cy = ds.sample_round(jax.random.fold_in(key, r), 2, 4)
+        st, m = eng.run_round(st, cx, cy, 0.2, 1.0, key)
+    return st, m
+
+
+@pytest.mark.parametrize("chunks", [1, 3])
+def test_engine_streaming_matches_dense_mean(chunks):
+    """Streaming round == dense round for the exact-form mean (chunks=3:
+    6 clients in 3 chunks of 2; the padded-chunk case is covered at K=7
+    below)."""
+    ds, W0 = _tiny_fixture()
+    dense = _tiny_engine(W0)
+    stream = _tiny_engine(W0, client_chunks=chunks, streaming=True)
+    sd, md = _one_round(dense, ds, W0)
+    ss, ms = _one_round(stream, ds, W0)
+    np.testing.assert_allclose(
+        np.asarray(ravel(ss.params)), np.asarray(ravel(sd.params)),
+        rtol=1e-5, atol=1e-7,
+    )
+    # losses/top1s are exact in streaming; variance is one-pass moments
+    np.testing.assert_allclose(float(ms.train_loss), float(md.train_loss),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        float(ms.update_variance), float(md.update_variance),
+        rtol=1e-4, atol=1e-8,
+    )
+
+
+def test_engine_padded_final_chunk_dense():
+    """K=7 with client_chunks=2 (chunk_size 4, pad 1) matches the
+    unchunked round — the old divisibility ValueError is gone and the
+    zero-padded row is exactly inert. K=6 @ chunks=4 additionally pins
+    the chunk-count renormalization: no chunk is ever 100% padding."""
+    ds, W0 = _tiny_fixture(k=7)
+    whole = _tiny_engine(W0, k=7)
+    padded = _tiny_engine(W0, k=7, client_chunks=2)
+    assert padded.chunk_size == 4 and padded._pad == 1
+    sw, _ = _one_round(whole, ds, W0)
+    sp, _ = _one_round(padded, ds, W0)
+    np.testing.assert_allclose(
+        np.asarray(ravel(sp.params)), np.asarray(ravel(sw.params)),
+        rtol=1e-5, atol=1e-7,
+    )
+    # renormalization: ceil(6/4)=2-sized chunks need only 3 chunks — a
+    # 4th all-pad chunk would be trained and thrown away every round
+    renorm = _tiny_engine(_tiny_fixture()[1], client_chunks=4)
+    assert renorm.client_chunks == 3 and renorm.chunk_size == 2
+    assert renorm._pad == 0
+
+
+def test_engine_chunks_clamp_to_population():
+    ds, W0 = _tiny_fixture()
+    eng = _tiny_engine(W0, client_chunks=64)
+    assert eng.client_chunks == BLOCK_K and eng.chunk_size == 1
+    st, m = _one_round(eng, ds, W0)
+    assert np.isfinite(float(m.train_loss))
+
+
+def test_engine_streaming_robust_agg_under_attack():
+    """Streaming trimmed-mean under sign-flipping: the two-level defense
+    tracks the dense one within the per-round update diameter (documented
+    bound), and training still descends."""
+    ds, W0 = _tiny_fixture()
+    kw = dict(
+        num_byzantine=2,
+        attack=get_attack("signflipping"),
+        aggregator=get_aggregator("trimmedmean", num_byzantine=2),
+    )
+    dense = _tiny_engine(W0, **kw)
+    stream = _tiny_engine(W0, client_chunks=3, streaming=True, **kw)
+    sd, md = _one_round(dense, ds, W0, rounds=3)
+    ss, ms = _one_round(stream, ds, W0, rounds=3)
+    assert np.isfinite(float(ms.train_loss))
+    # 3 rounds of server steps on a 0.1-scale linear model: the two-level
+    # trim stays within the honest cloud, so params stay close
+    np.testing.assert_allclose(
+        np.asarray(ravel(ss.params)), np.asarray(ravel(sd.params)),
+        rtol=0.2, atol=0.05,
+    )
+
+
+def test_engine_streaming_fault_masks_match_dense():
+    """Dropout + NaN corruption draws are bit-identical between the dense
+    fault pass and the streaming plan (same key splits), so the per-round
+    fault counters agree exactly."""
+    ds, W0 = _tiny_fixture()
+    fm = FaultModel(dropout_rate=0.3, corrupt_rate=0.3, corrupt_mode="nan")
+    dense = _tiny_engine(W0, fault_model=fm)
+    stream = _tiny_engine(W0, client_chunks=3, streaming=True, fault_model=fm)
+    _, _ = _one_round(dense, ds, W0)
+    _, _ = _one_round(stream, ds, W0)
+    d_diag = {k: int(v) for k, v in dense.last_fault_diag.items()}
+    s_diag = {k: int(v) for k, v in stream.last_fault_diag.items()}
+    assert d_diag == s_diag
+    assert s_diag["participants"] <= BLOCK_K
+
+
+def test_engine_streaming_audit_breach_swaps_fallback():
+    """Streaming audit: a mean aggregate dragged out by sign-flipped rows
+    breaches the streaming certificates and the round applies the
+    (streaming) median fallback in-graph; the attack-free twin certifies
+    clean."""
+    ds, W0 = _tiny_fixture()
+    mon = AuditMonitor(fallback_aggregator="median")
+    clean = _tiny_engine(W0, client_chunks=3, streaming=True,
+                         audit_monitor=mon)
+    _one_round(clean, ds, W0)
+    assert int(clean.last_audit_diag["breach"]) == 0
+
+    attacked = _tiny_engine(
+        W0, client_chunks=3, streaming=True, audit_monitor=mon,
+        num_byzantine=2,
+        attack=get_attack("noise", mean=50.0, std=1.0),
+        aggregator=get_aggregator("mean"),
+    )
+    _, m = _one_round(attacked, ds, W0)
+    assert int(attacked.last_audit_diag["breach"]) == 1
+    assert int(attacked.last_audit_diag["fallback_used"]) == 1
+    assert np.isfinite(float(m.agg_norm))
+
+
+def test_engine_streaming_block_bit_exact():
+    """A block of streaming rounds is bit-identical to sequential streaming
+    rounds — run_block scans the SAME streaming body, so the round-block
+    invariant carries over unchanged."""
+    ds, W0 = _tiny_fixture()
+    key = jax.random.PRNGKey(7)
+    dk = jax.random.fold_in(key, 23)
+    eng = _tiny_engine(W0, client_chunks=3, streaming=True,
+                       aggregator=get_aggregator("median"))
+    st = eng.init(W0)
+    for r in range(1, 3):
+        cx, cy = ds.sample_round(jax.random.fold_in(dk, r), 2, 4)
+        st, _ = eng.run_round(st, cx, cy, 0.2, 1.0, key)
+
+    st2 = eng.init(W0)
+    keys = jnp.stack([jax.random.fold_in(dk, r) for r in range(1, 3)])
+    st2, ms, _ = eng.run_block(
+        st2, keys, [0.2, 0.2], [1.0, 1.0], key,
+        sampler=ds.traceable_sampler(2, 4),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ravel(st.params)), np.asarray(ravel(st2.params))
+    )
+
+
+def test_engine_streaming_build_time_validation():
+    """Misconfigurations fail at engine build with the documented reason,
+    never at trace time."""
+    _, W0 = _tiny_fixture()
+    with pytest.raises(ValueError, match="does not implement streaming"):
+        _tiny_engine(W0, streaming=True, aggregator=get_aggregator("fltrust"))
+    with pytest.raises(ValueError, match="full-population"):
+        _tiny_engine(
+            W0, streaming=True, num_byzantine=2,
+            attack=get_attack("alie", num_clients=BLOCK_K, num_byzantine=2),
+        )
+    with pytest.raises(ValueError, match="straggler"):
+        _tiny_engine(W0, streaming=True,
+                     fault_model=FaultModel(straggler_rate=0.5))
+    with pytest.raises(ValueError, match="collect_diagnostics"):
+        _tiny_engine(W0, streaming=True, collect_diagnostics=True)
+    with pytest.raises(ValueError, match="fallback"):
+        _tiny_engine(
+            W0, streaming=True,
+            audit_monitor=AuditMonitor(fallback_aggregator=_agg("dnc")),
+        )
+    # conditional support: the async clipper's single-pass form exists
+    # only at n_iter=1 — n_iter>1 must be rejected at BUILD time too
+    with pytest.raises(ValueError, match="n_iter"):
+        _tiny_engine(
+            W0, streaming=True,
+            aggregator=get_aggregator("asynccenteredclipping", n_iter=2),
+        )
+
+
+def test_peak_update_bytes_estimates():
+    """The memory gauge: dense rounds account the (padded) [K, D] matrix,
+    streaming rounds one [chunk, D] slab — the quantity the K-scaling
+    evidence rows commit."""
+    _, W0 = _tiny_fixture()
+    d = sum(int(np.prod(v.shape)) for v in jax.tree_util.tree_leaves(W0))
+    dense = _tiny_engine(W0, client_chunks=4)  # renormalized: 3 chunks of 2
+    assert dense.peak_update_bytes == 6 * d * 4
+    padded = _tiny_engine(W0, k=7, client_chunks=2)  # chunk 4, pad 1
+    assert padded.peak_update_bytes == 8 * d * 4
+    stream = _tiny_engine(W0, client_chunks=3, streaming=True)
+    assert stream.peak_update_bytes == 2 * d * 4
+
+
+def test_engine_streaming_persistent_client_opt():
+    """Per-client Adam moments ride the chunk scan: stacked [K, ...] state
+    survives a streaming round (and matches the dense round tightly — the
+    optimizer math is per-client, only the aggregate differs by
+    re-association)."""
+    ds, W0 = _tiny_fixture()
+    kw = dict(client_opt=ClientOptSpec(name="adam", persist=True))
+    dense = _tiny_engine(W0, **kw)
+    stream = _tiny_engine(W0, client_chunks=3, streaming=True, **kw)
+    sd, _ = _one_round(dense, ds, W0)
+    ss, _ = _one_round(stream, ds, W0)
+    leaves = jax.tree_util.tree_leaves(ss.client_opt_state)
+    assert leaves[0].shape[0] == BLOCK_K
+    np.testing.assert_allclose(
+        np.asarray(ravel(ss.params)), np.asarray(ravel(sd.params)),
+        rtol=1e-5, atol=1e-7,
+    )
+
+
+# ------------------------------------------- streaming audit certificates
+
+
+def _stream_certify(mon, updates, agg, num_chunks, mask=None):
+    """Drive the monitor's streaming protocol the way the engine does."""
+    from blades_tpu.ops.streaming import chunk_layout
+
+    k, d = updates.shape
+    c, chunk, pad = chunk_layout(k, num_chunks)
+    mask = jnp.ones(k, bool) if mask is None else jnp.asarray(mask)
+    u = jnp.pad(jnp.asarray(updates), ((0, pad), (0, 0)))
+    m = jnp.pad(mask, (0, pad))
+    ss = mon.streaming_init(k, c, chunk, d)
+    for j in range(c):
+        rows = slice(j * chunk, (j + 1) * chunk)
+        mc = m[rows]
+        safe = jnp.where(mc[:, None], u[rows], 0.0)
+        ss = mon.streaming_update(
+            ss, safe, chunk_mask=mc, chunk_index=jnp.asarray(j, jnp.int32)
+        )
+    return mon.streaming_apply(ss, jnp.asarray(agg))
+
+
+def test_streaming_certificates_singleton_chunks_equal_dense():
+    """chunk_size=1 collapses every interval bound to a point: the
+    streaming certificates ARE the dense ones."""
+    u = rand_updates(seed=9)
+    agg = u.mean(axis=0)
+    mon = AuditMonitor()
+    breach_d, diag_d = mon.certify(jnp.asarray(u), jnp.asarray(agg))
+    _, diag_s = _stream_certify(mon, u, agg, num_chunks=K)
+    assert bool(breach_d) == bool(diag_s["breach"])
+    np.testing.assert_allclose(
+        float(diag_s["dev_median"]), float(diag_d["dev_median"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(diag_s["spread_median"]), float(diag_d["spread_median"]),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(diag_s["diameter"]), float(diag_d["diameter"]), rtol=1e-5
+    )
+
+
+def test_streaming_certificate_bounds_bracket_dense():
+    """With real chunks the lo/hi interval forensics bracket the dense
+    statistics (the certificates evaluate on the tolerant side of each)."""
+    u = rand_updates(seed=10)
+    agg = u.mean(axis=0)
+    mon = AuditMonitor()
+    _, diag_d = mon.certify(jnp.asarray(u), jnp.asarray(agg))
+    _, diag_s = _stream_certify(mon, u, agg, num_chunks=3)
+    eps = 1e-5
+    assert (
+        float(diag_s["spread_median_lo"]) - eps
+        <= float(diag_d["spread_median"])
+        <= float(diag_s["spread_median"]) + eps
+    )
+    assert (
+        float(diag_s["diameter_lo"]) - eps
+        <= float(diag_d["diameter"])
+        <= float(diag_s["diameter"]) + eps
+    )
+
+
+def test_streaming_certificates_flag_gross_breach():
+    """A far-out aggregate breaches even under the tolerant interval
+    evaluation; a benign aggregate certifies clean."""
+    rng = np.random.default_rng(11)
+    u = (rng.normal(size=(K, D)) * 0.1).astype(np.float32)
+    mon = AuditMonitor()
+    _, diag_ok = _stream_certify(mon, u, u.mean(axis=0), num_chunks=3)
+    assert int(diag_ok["breach"]) == 0
+    _, diag_bad = _stream_certify(
+        mon, u, u.mean(axis=0) + 100.0, num_chunks=3
+    )
+    assert int(diag_bad["breach"]) == 1

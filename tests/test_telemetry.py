@@ -277,6 +277,63 @@ def test_telemetry_disabled_writes_no_trace(tmp_path, monkeypatch):
     assert read_stats(str(tmp_path / "out"), "test")
 
 
+def test_trace_summary_memory_section(tmp_path):
+    """The engine memory gauges ride round records and surface as the
+    summary's `memory` section (docs/performance.md 'Memory scaling'):
+    max peak bytes + the layout fields from the latest round."""
+    path = str(tmp_path / "mem.jsonl")
+    rec = Recorder(enabled=True, path=path)
+    rec.gauge("engine.peak_update_bytes", 123456)
+    rec.gauge("engine.client_chunks", 4)
+    rec.gauge("engine.chunk_size", 25)
+    rec.gauge("engine.streaming", 1)
+    rec.round_record(1, wall_s=0.1)
+    rec.round_record(2, wall_s=0.1)
+    rec.close()
+    summary = summarize(load_records(path))
+    assert summary["memory"] == {
+        "peak_update_bytes": 123456,
+        "streaming": 1,
+        "client_chunks": 4,
+        "chunk_size": 25,
+    }
+    assert "peak_update_bytes=123456" in format_table(summary)
+
+
+def test_simulator_streaming_run_gauges_memory(tmp_path):
+    """E2E: a streaming simulator run records [chunk, D]-scale
+    peak_update_bytes (vs the dense [K, D]) in its trace, and the padded
+    non-divisor chunk count runs end to end."""
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import Synthetic
+
+    log = str(tmp_path / "out")
+    sim = Simulator(
+        dataset=Synthetic(
+            num_clients=6, train_size=240, test_size=60, noise=0.3,
+            cache=False,
+        ),
+        aggregator="median",
+        log_path=log,
+    )
+    sim.run(
+        "mlp", global_rounds=1, local_steps=1, client_lr=0.2,
+        train_batch_size=4, validate_interval=1,
+        # 6 % 4 != 0: ceil chunks of 2, renormalized to 3 chunks
+        client_chunks=4, streaming=True,
+    )
+    summary = summarize(load_records(os.path.join(log, "telemetry.jsonl")))
+    mem = summary["memory"]
+    assert mem["streaming"] == 1 and mem["client_chunks"] == 3
+    assert mem["chunk_size"] == 2
+    assert mem["peak_update_bytes"] == 2 * sim.engine.dim * 4
+    # retain_updates needs the matrix streaming never builds
+    with pytest.raises(ValueError, match="retain_updates"):
+        sim.run(
+            "mlp", global_rounds=1, streaming=True, retain_updates=True,
+        )
+
+
 def test_trace_summary_cli_main(tmp_path, capsys):
     import trace_summary
 
