@@ -141,7 +141,18 @@ def child_main() -> None:
     num_byz = int(num_byz_env) if num_byz_env else 0
     client_opt_name = os.environ.get("BENCH_CLIENT_OPT", "sgd")
     num_classes = int(os.environ.get("BENCH_NUM_CLASSES", 10))
-    profile_dir = os.environ.get("BENCH_PROFILE_DIR") or None
+    # BLADES_PROFILE is the repo-wide profiler knob (Simulator honors it
+    # too, incl. the older BLADES_TELEMETRY_PROFILE_DIR alias);
+    # BENCH_PROFILE_DIR stays as the bench-local override. The rule is
+    # inlined rather than calling profiling.profile_dir_from_env():
+    # child_main reads its env before any blades_tpu/jax import on purpose
+    # (a dead TPU tunnel must fail in the 'import' stage, not earlier)
+    profile_dir = (
+        os.environ.get("BENCH_PROFILE_DIR")
+        or os.environ.get("BLADES_PROFILE")
+        or os.environ.get("BLADES_TELEMETRY_PROFILE_DIR")
+        or None
+    )
     # remat trades a second forward pass for activation HBM; on by default
     # (the K=1000 headline needs it), off to measure its cost at smaller K
     remat = os.environ.get("BENCH_REMAT", "1") != "0"
@@ -311,8 +322,13 @@ def child_main() -> None:
         jax.block_until_ready(state.params)
 
         stage = "timed"
+        profiled = False
         if profile_dir:
-            jax.profiler.start_trace(profile_dir)
+            # guarded capture spanning the timed region: degrades to a
+            # recorded no-op where the backend/attachment lacks tracing
+            from blades_tpu.telemetry.profiling import start_capture
+
+            profiled = start_capture(profile_dir, telem)
         t0 = time.time()
         launches = 0
         r = warmup_rounds
@@ -326,8 +342,10 @@ def child_main() -> None:
             launches += 1
         jax.block_until_ready(state.params)
         elapsed = time.time() - t0
-        if profile_dir:
-            jax.profiler.stop_trace()
+        if profiled:
+            from blades_tpu.telemetry.profiling import stop_capture
+
+            stop_capture(profile_dir, telem)
         timed = timed_rounds
 
         loss = float(m.train_loss if block == 1 else m.train_loss[-1])
@@ -407,7 +425,10 @@ def child_main() -> None:
         # cost_analysis is best-effort — some backends/attachment modes
         # don't expose it
         tflop_per_round = None
+        program_profile = None
         try:
+            from blades_tpu.telemetry.profiling import cost_fields
+
             if block > 1:
                 # the block program's cost model counts the lax.scan BODY
                 # once (trip count is not multiplied in), so per-round
@@ -426,12 +447,15 @@ def child_main() -> None:
                 jnp.asarray(1.0, jnp.float32),
                 key,
             )
-            ca = lowered.compile().cost_analysis()
-            if isinstance(ca, (list, tuple)):
-                ca = ca[0]
-            flops = float(ca.get("flops", 0.0))
-            if flops > 0:
-                tflop_per_round = flops / 1e12
+            # full measured profile of the exact compiled round program:
+            # cost-model flops/bytes + (where the backend exposes it) the
+            # compiled temp/argument/output buffer budget — the payload's
+            # MEASURED memory number next to the analytical
+            # peak_update_bytes estimate (scripts/perf_report.py compares
+            # them across runs)
+            program_profile = cost_fields(lowered.compile()) or None
+            if program_profile and program_profile.get("flops", 0) > 0:
+                tflop_per_round = program_profile["flops"] / 1e12
         except Exception:
             pass
 
@@ -465,6 +489,8 @@ def child_main() -> None:
                     "local_steps": local_steps,
                     "train_loss": loss,
                     "tflop_per_round": tflop_per_round,
+                    "program_profile": program_profile,
+                    "profiled": profiled,
                     "telemetry": telemetry,
                     "platform": devices[0].platform,
                     "n_devices": len(devices),
@@ -698,6 +724,10 @@ def _ladder_main() -> None:
     # child payload lacks it, never fabricated here
     if result.get("telemetry") is not None:
         payload["telemetry"] = result["telemetry"]
+    # measured program profile (cost-model flops/bytes + compiled buffer
+    # budget) of the exact round program — perf_report.py reads it
+    if result.get("program_profile") is not None:
+        payload["program_profile"] = result["program_profile"]
     # efficiency fields: sustained TFLOPS from the XLA cost model of the
     # exact compiled round program, and MFU against the v5e bf16 peak.
     # Carried on every path; mfu is null off-accelerator (the CPU fallback

@@ -52,6 +52,12 @@ from blades_tpu.ops.streaming import (
 )
 from blades_tpu.parallel.mesh import ShardingPlan
 from blades_tpu.telemetry import get_recorder
+from blades_tpu.telemetry.metric_pack import (
+    pack_dense,
+    pack_finalize,
+    pack_init,
+    pack_update,
+)
 from blades_tpu.utils import rng
 
 
@@ -176,6 +182,7 @@ class RoundEngine:
         fault_model: Optional[FaultModel] = None,
         audit_monitor: Optional[AuditMonitor] = None,
         streaming: bool = False,
+        round_metrics: bool = False,
     ):
         """``client_chunks``: split the K client axis into this many
         sequential chunks (``lax.map`` outside, vmap inside). Each chunk still
@@ -254,7 +261,22 @@ class RoundEngine:
         — with an optional stateless fallback aggregator swapped in (one
         ``where``) for any round whose enforced certificates breach.
         Certificate/fallback forensics land in ``self.last_audit_diag``.
-        ``None`` (default) compiles the exact pre-audit program."""
+        ``None`` (default) compiles the exact pre-audit program.
+
+        ``round_metrics``: trace a fixed-shape
+        :class:`~blades_tpu.telemetry.metric_pack.MetricPack` (update-norm
+        quantiles/histogram, honest-vs-byzantine cosine-to-aggregate,
+        mask counts, per-chunk slab extremes) into the round body —
+        in-graph, so the per-round signal survives round-block and
+        streaming fusion as stacked scan outputs. Static branch: ``False``
+        (default) compiles the exact pre-metrics program (no extra
+        outputs, compile count pinned in ``tests/test_metric_pack.py``);
+        ``True`` adds zero extra program launches. The pack content is
+        execution-schedule invariant — ``run_round`` == ``run_block`` ==
+        ``streaming`` for identical row content (see
+        ``telemetry/metric_pack.py``). Per round the pack lands in
+        ``self.last_metric_pack`` and (under :class:`Simulator`) as one
+        ``metrics`` telemetry record."""
         self.train_loss_fn = train_loss_fn
         self.eval_logits_fn = eval_logits_fn
         self.num_clients = int(num_clients)
@@ -286,6 +308,8 @@ class RoundEngine:
         self.last_fault_diag: Any = None
         self.audit_monitor = audit_monitor
         self.last_audit_diag: Any = None
+        self.round_metrics = bool(round_metrics)
+        self.last_metric_pack: Any = None
         if self.streaming:
             self._validate_streaming(aggregator, attack, fault_model,
                                      audit_monitor, collect_diagnostics)
@@ -647,6 +671,23 @@ class RoundEngine:
                 **audit_ctx,
             )
 
+        # in-graph round metrics (static branch — disabled compiles the
+        # exact pre-metrics program): computed on the matrix the defense
+        # consumed, against the aggregate the server APPLIES (post-audit
+        # fallback), folded over the same chunk layout the streaming scan
+        # walks so dense == block == streaming content
+        metric_pack = ()
+        if self.round_metrics:
+            mp_mask = (
+                part_mask
+                if part_mask is not None
+                else jnp.ones(self.num_clients, bool)
+            )
+            metric_pack = pack_dense(
+                updates, mp_mask, self.byz_mask, agg,
+                self.client_chunks, self.chunk_size,
+            )
+
         # server pseudo-gradient step: grad := -agg (server.py:54-75)
         grad_tree = self.unravel(-agg)
         server_updates, server_opt_state = self._server_tx.update(
@@ -691,6 +732,7 @@ class RoundEngine:
             agg_diag,
             fault_diag,
             audit_diag,
+            metric_pack,
         )
 
     def _round_streaming(self, state: RoundState, cx, cy, client_lr, server_lr, key):
@@ -764,9 +806,10 @@ class RoundEngine:
             else ()
         )
         zero = jnp.asarray(0, jnp.int32)
+        mp0 = pack_init(c, self.dim) if self.round_metrics else ()
         carry0 = (
             agg_ss, fb_ss, aud_ss, state.attack_state,
-            moments_init(self.dim), zero, zero,
+            moments_init(self.dim), zero, zero, mp0,
         )
         xs = (
             chunked(opt_arg) if persist else (),
@@ -777,7 +820,7 @@ class RoundEngine:
         )
 
         def body(carry, xs_t):
-            agg_ss, fb_ss, aud_ss, att_state, mom, n_part, n_excl = carry
+            agg_ss, fb_ss, aud_ss, att_state, mom, n_part, n_excl, mp = carry
             o, x, y, ck, byz, ids, val, j, p0, cor = xs_t
             upd, new_opt, losses, top1s = vmapped(
                 state.params, o if persist else (), client_lr, x, y, ck,
@@ -819,14 +862,21 @@ class RoundEngine:
                 aud_ss = self.audit_monitor.streaming_update(
                     aud_ss, safe, chunk_mask=mask_c, chunk_index=j
                 )
+            # in-graph round metrics: fold the SAME sanitized slab + mask
+            # the aggregator consumed; per-row norms/masks stack through
+            # the scan ([K] scalars — cheap at any K)
+            mp_ys = ()
+            if self.round_metrics:
+                mp, mp_norms = pack_update(mp, safe, mask_c, byz, j)
+                mp_ys = (mp_norms, mask_c)
             return (
-                (agg_ss, fb_ss, aud_ss, att_state, mom, n_part, n_excl),
-                (new_opt if persist else (), losses, top1s),
+                (agg_ss, fb_ss, aud_ss, att_state, mom, n_part, n_excl, mp),
+                (new_opt if persist else (), losses, top1s, mp_ys),
             )
 
         carry, ys = lax.scan(body, carry0, xs)
-        agg_ss, fb_ss, aud_ss, attack_state, mom, n_part, n_excl = carry
-        new_opt_c, losses_c, top1s_c = ys
+        agg_ss, fb_ss, aud_ss, attack_state, mom, n_part, n_excl, mp = carry
+        new_opt_c, losses_c, top1s_c, mp_ys_c = ys
         losses, top1s = unchunk((losses_c, top1s_c))
         new_client_opt = unchunk(new_opt_c) if persist else ()
 
@@ -845,6 +895,14 @@ class RoundEngine:
             agg, audit_diag = self.audit_monitor.streaming_apply(
                 aud_ss, agg, fallback_agg=fb_agg
             )
+
+        # close the in-graph metrics fold against the APPLIED aggregate —
+        # same finalize the dense body runs, so content matches across
+        # execution schedules (telemetry/metric_pack.py)
+        metric_pack = ()
+        if self.round_metrics:
+            mp_norms_k, mp_mask_k = unchunk(mp_ys_c)
+            metric_pack = pack_finalize(mp, mp_norms_k, mp_mask_k, agg)
 
         fault_state = state.fault_state
         if self.fault_model is not None:
@@ -887,7 +945,7 @@ class RoundEngine:
             round_idx=state.round_idx + 1,
             fault_state=fault_state,
         )
-        return new_state, metrics, (), {}, fault_diag, audit_diag
+        return new_state, metrics, (), {}, fault_diag, audit_diag, metric_pack
 
     def run_round(
         self,
@@ -919,6 +977,7 @@ class RoundEngine:
                 agg_diag,
                 fault_diag,
                 audit_diag,
+                metric_pack,
             ) = self._round_jit(
                 state,
                 cx,
@@ -933,6 +992,7 @@ class RoundEngine:
         self.last_audit_diag = (
             audit_diag if self.audit_monitor is not None else None
         )
+        self.last_metric_pack = metric_pack if self.round_metrics else None
         return new_state, metrics
 
     # -- round-block execution -----------------------------------------------
@@ -947,10 +1007,13 @@ class RoundEngine:
             def body(st, per_round):
                 skey, c_lr, s_lr = per_round
                 cx, cy = sampler(skey)
-                new_st, metrics, _updates, agg_diag, fault_diag, audit_diag = (
-                    self._round(st, cx, cy, c_lr, s_lr, key)
+                (
+                    new_st, metrics, _updates, agg_diag, fault_diag,
+                    audit_diag, metric_pack,
+                ) = self._round(st, cx, cy, c_lr, s_lr, key)
+                return new_st, (
+                    metrics, agg_diag, fault_diag, audit_diag, metric_pack
                 )
-                return new_st, (metrics, agg_diag, fault_diag, audit_diag)
 
             final, ys = lax.scan(
                 body, state, (sample_keys, client_lrs, server_lrs)
@@ -998,7 +1061,7 @@ class RoundEngine:
             self._block_sampler = sampler
         r = int(sample_keys.shape[0])
         with get_recorder().span("dispatch", rounds=r):
-            new_state, (metrics, agg_diag, fault_diag, audit_diag) = (
+            new_state, (metrics, agg_diag, fault_diag, audit_diag, mpacks) = (
                 self._block_jit(
                     state,
                     sample_keys,
@@ -1016,10 +1079,12 @@ class RoundEngine:
         self.last_audit_diag = (
             last(audit_diag) if self.audit_monitor is not None else None
         )
+        self.last_metric_pack = last(mpacks) if self.round_metrics else None
         diags = {
             "defense": agg_diag if self.collect_diagnostics else None,
             "faults": fault_diag if self.fault_model is not None else None,
             "audit": audit_diag if self.audit_monitor is not None else None,
+            "metrics": mpacks if self.round_metrics else None,
         }
         return new_state, metrics, diags
 

@@ -42,6 +42,8 @@ from blades_tpu.parallel.mesh import auto_mesh_shape, make_mesh, make_plan
 from blades_tpu.server import BladesServer
 from blades_tpu.supervision import heartbeat as _heartbeat
 from blades_tpu.telemetry import Recorder, install_jax_monitoring, set_recorder
+from blades_tpu.telemetry import profiling as _profiling
+from blades_tpu.telemetry.metric_pack import pack_to_fields
 from blades_tpu.utils.checkpoint import checkpoint_file, restore_state, save_state
 from blades_tpu.utils.logging import initialize_logger
 from blades_tpu.utils.metrics import top1_accuracy
@@ -316,6 +318,7 @@ class Simulator:
         audit_monitor: Optional[Union[AuditMonitor, Dict]] = None,
         block_size: int = 1,
         streaming: bool = False,
+        round_metrics: Optional[bool] = None,
     ) -> List[float]:
         """Run adversarial training; returns per-round wall times (reference
         ``run`` contract, ``simulator.py:364-457``).
@@ -387,15 +390,35 @@ class Simulator:
         reason). Per-run ``engine.peak_update_bytes`` /
         ``engine.client_chunks`` / ``engine.chunk_size`` /
         ``engine.streaming`` gauges ride every telemetry round record.
+        ``round_metrics``: trace a fixed-shape in-graph
+        :class:`~blades_tpu.telemetry.metric_pack.MetricPack` (update-norm
+        quantiles/histogram, honest-vs-byzantine cosine-to-aggregate,
+        mask/exclusion counts, per-chunk slab extremes) into the round
+        body and log one ``metrics`` telemetry record per round — the
+        per-round visibility that survives ``block_size>1`` and
+        ``streaming=True`` fusion (the pack rides the scans as stacked
+        outputs and is unstacked here). Default: the
+        ``BLADES_ROUND_METRICS=1`` env knob; off compiles the exact
+        pre-metrics program.
 
         Telemetry (``docs/observability.md``): unless ``BLADES_TELEMETRY=0``,
         a span/counter trace of the run is appended to
         ``<log_path>/telemetry.jsonl`` — per-round span tree (sample /
         dispatch / sync / eval / checkpoint), XLA compile + persistent-cache
         accounting, and defense forensics — flushed once per round.
-        Summarize with ``python scripts/trace_summary.py``.
-        ``BLADES_TELEMETRY_PROFILE_DIR`` is an env alias for ``profile_dir``
-        (a ~3-round ``jax.profiler`` capture) for real-TPU windows.
+        Summarize with ``python scripts/trace_summary.py``. The first
+        round (or block) additionally records a measured program profile
+        (XLA cost-model flops / bytes accessed and, where the backend
+        exposes it, the compiled temp/argument/output buffer budget) as a
+        ``memory`` record next to the analytical
+        ``engine.peak_update_bytes`` gauge, and device allocator
+        watermarks land as ``mem.*`` gauges at every flush point on
+        backends that report them (``blades_tpu/telemetry/profiling.py``;
+        ``BLADES_PROGRAM_PROFILE=0`` disables the per-program record).
+        ``BLADES_PROFILE`` (alias ``BLADES_TELEMETRY_PROFILE_DIR``) is an
+        env knob for ``profile_dir`` (a guarded ~3-round ``jax.profiler``
+        capture that degrades to a recorded no-op where tracing is
+        unavailable) for real-TPU windows.
 
         Supervision (``docs/robustness.md``): under the run supervisor
         (``python -m blades_tpu.supervision -- ...``) the loop touches the
@@ -413,9 +436,9 @@ class Simulator:
         resume = resume or os.environ.get(_heartbeat.RESUME_ENV) == "1"
         if collect_diagnostics is None:
             collect_diagnostics = os.environ.get("BLADES_TELEMETRY_DIAG") == "1"
-        profile_dir = profile_dir or os.environ.get(
-            "BLADES_TELEMETRY_PROFILE_DIR"
-        ) or None
+        if round_metrics is None:
+            round_metrics = os.environ.get("BLADES_ROUND_METRICS") == "1"
+        profile_dir = profile_dir or _profiling.profile_dir_from_env()
         if isinstance(fault_model, dict):
             fault_model = FaultModel(**fault_model)
         if isinstance(audit_monitor, dict):
@@ -508,6 +531,7 @@ class Simulator:
             fault_model=fault_model,
             audit_monitor=audit_monitor,
             streaming=streaming,
+            round_metrics=round_metrics,
         )
         # memory observability: the round program's peak update-matrix
         # footprint rides every round record as gauges (streaming rounds
@@ -645,8 +669,11 @@ class Simulator:
             else:
                 for rnd in range(start_round, global_rounds + 1):
                     if profile_dir and rnd == prof_first:
-                        jax.profiler.start_trace(profile_dir)
-                        trace_active = True
+                        # guarded capture: degrades to a recorded no-op on
+                        # backends/attachment modes without profiler support
+                        trace_active = _profiling.start_capture(
+                            profile_dir, rec
+                        )
                     round_start = time.time()
                     with rec.span("round"):
                         with rec.span("sample"):
@@ -670,6 +697,21 @@ class Simulator:
                         self._log_defense(rnd)
                         self._log_faults(rnd)
                         self._log_audit(rnd)
+                        self._log_metrics(rnd)
+                        if rnd == start_round:
+                            # one measured program profile per run: XLA
+                            # cost/memory analysis of the exact compiled
+                            # round program (cache-hit compile; `memory`
+                            # record next to the analytical
+                            # engine.peak_update_bytes gauge)
+                            with rec.span("program_profile"):
+                                _profiling.record_program_profile(
+                                    "round", self.engine._round_jit,
+                                    state, cx, cy,
+                                    jnp.asarray(c_lr, jnp.float32),
+                                    jnp.asarray(s_lr, jnp.float32),
+                                    key, rec=rec,
+                                )
                         if retain_updates:
                             # populate reference-parity client.get_update() views
                             for i, c in enumerate(self.get_clients()):
@@ -690,7 +732,7 @@ class Simulator:
 
                         if trace_active and rnd == prof_last:
                             jax.block_until_ready(state.params)
-                            jax.profiler.stop_trace()
+                            _profiling.stop_capture(profile_dir, rec)
                             trace_active = False
                         if (
                             checkpoint_path
@@ -702,6 +744,9 @@ class Simulator:
 
                     wall = time.time() - round_start
                     round_times.append(wall)
+                    # measured allocator watermarks (no-op on backends
+                    # without memory_stats) ride the round record's gauges
+                    _profiling.record_live_bytes(rec)
                     # per-round summary + the round's single buffered trace write
                     rec.round_record(
                         rnd,
@@ -810,13 +855,13 @@ class Simulator:
         def slice_round(tree, i):
             return jax.tree_util.tree_map(lambda a: a[i], tree)
 
+        profiled = False
         rnd = start_round
         while rnd <= global_rounds:
             bs = min(block_size, global_rounds - rnd + 1)
             rounds = range(rnd, rnd + bs)
             if profile_dir and not trace_active and rnd <= prof_first < rnd + bs:
-                jax.profiler.start_trace(profile_dir)
-                trace_active = True
+                trace_active = _profiling.start_capture(profile_dir, rec)
             block_start = time.time()
             with rec.span("block", rounds=bs):
                 sample_keys = jnp.stack(
@@ -842,6 +887,26 @@ class Simulator:
                         self._log_faults(r, diag=slice_round(diags["faults"], i))
                     if diags["audit"] is not None:
                         self._log_audit(r, diag=slice_round(diags["audit"], i))
+                    if diags["metrics"] is not None:
+                        # in-graph MetricPack, unstacked from the block's
+                        # [R]-leading scan outputs: per-round records
+                        # survive fused execution
+                        self._log_metrics(
+                            r, pack=slice_round(diags["metrics"], i)
+                        )
+
+                if not profiled:
+                    # one measured program profile per run (the scanned
+                    # block program; cache-hit compile, `memory` record)
+                    profiled = True
+                    with rec.span("program_profile"):
+                        _profiling.record_program_profile(
+                            "block", self.engine._block_jit,
+                            state, sample_keys,
+                            jnp.asarray(c_lrs, jnp.float32),
+                            jnp.asarray(s_lrs, jnp.float32),
+                            key, rec=rec,
+                        )
 
                 if any(r % validate_interval == 0 for r in rounds):
                     with rec.span("eval"):
@@ -853,7 +918,7 @@ class Simulator:
 
                 if trace_active and rounds[-1] >= prof_last:
                     jax.block_until_ready(state.params)
-                    jax.profiler.stop_trace()
+                    _profiling.stop_capture(profile_dir, rec)
                     trace_active = False
                 if (
                     checkpoint_path
@@ -864,6 +929,9 @@ class Simulator:
                         save_state(checkpoint_path, state)
 
             wall = time.time() - block_start
+            # allocator watermarks at the block boundary (the streaming/
+            # block flush point) — no-op without backend memory_stats
+            _profiling.record_live_bytes(rec)
             for i, r in enumerate(rounds):
                 round_times.append(wall / bs)
                 # per-round summaries (amortized wall), ONE buffered trace
@@ -1042,6 +1110,26 @@ class Simulator:
         self.telemetry.event(
             "audit", round=rnd, agg=repr(self.aggregator), **fields
         )
+
+    def _log_metrics(self, rnd: int, pack=None) -> None:
+        """In-graph round metrics -> one ``metrics`` telemetry record per
+        round: update-norm quantiles + fixed-log-bin histogram,
+        honest-vs-byzantine cosine-to-aggregate, participation/exclusion
+        counts, and per-chunk slab extremes — computed INSIDE the compiled
+        round body (``telemetry/metric_pack.py``), so the record survives
+        round-block and streaming fusion unchanged (``pack`` = one round's
+        slice of the block's stacked packs). The headline geometry fields
+        also land as gauges so every ``round`` record carries the latest.
+        Reference counterpart: none (``src/blades/simulator.py:453-455``
+        records loss/wall-time only)."""
+        if pack is None:
+            pack = getattr(self.engine, "last_metric_pack", None)
+        if pack is None or pack == () or not self.telemetry.enabled:
+            return
+        fields = pack_to_fields(pack)
+        for name in ("cos_honest", "cos_byz", "norm_median", "participants"):
+            self.telemetry.gauge(f"metrics.{name}", fields[name])
+        self.telemetry.event("metrics", round=rnd, **fields)
 
     def evaluate(self, rnd: int, batch_size: int = 64) -> Dict:
         """Reference test flow (``test_actor`` -> ``log_validate``,

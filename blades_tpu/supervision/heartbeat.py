@@ -24,7 +24,9 @@ Protocol:
 The file body is a single JSON ``heartbeat`` record (schema in
 ``docs/observability.md``) so a post-mortem can see *where* the run was,
 not just *when* it last moved: ``{"t": "heartbeat", "ts": ..., "pid": ...,
-"round": N}``.
+"round": N, "interval_s": ...}`` — ``interval_s`` is the measured gap
+since this process's previous beat, which also feeds the heartbeat-margin
+gauge (:data:`TIMEOUT_ENV`).
 
 Stdlib-only (like the telemetry recorder): importable before jax and from
 any subprocess. Reference counterpart: none — the reference assumes a
@@ -53,6 +55,22 @@ SUPERVISED_ENV = "BLADES_SUPERVISED"
 #: crash autosave / latest checkpoint instead of restarting from scratch.
 RESUME_ENV = "BLADES_RESUME"
 
+#: Env var the supervisor sets to its ``--heartbeat-timeout`` (seconds) so
+#: the workload can measure its own margin: :func:`beat` gauges the
+#: time-since-last-beat and emits a ``heartbeat_margin`` warning record
+#: when a beat lands within :data:`MARGIN_WARN_FRAC` of the kill
+#: threshold — the between-beat cold-compile gap (CLAUDE.md) becomes
+#: visible in the trace BEFORE it kills a run.
+TIMEOUT_ENV = "BLADES_HEARTBEAT_TIMEOUT"
+
+#: Warn when the observed beat interval exceeds this fraction of the
+#: supervisor's timeout (i.e. the beat landed within 25% of being fatal).
+MARGIN_WARN_FRAC = 0.75
+
+# wall-clock of this process's previous beat (margin measurement only —
+# the supervisor keeps reading file mtime, never this)
+_last_beat_ts: Optional[float] = None
+
 
 def heartbeat_path() -> Optional[str]:
     """The heartbeat file path for this process (None when unsupervised)."""
@@ -67,12 +85,43 @@ def beat(round_idx: Optional[int] = None, path: Optional[str] = None) -> None:
     heartbeat observes — a stale heartbeat then (correctly) reports the
     environment as unhealthy.
     """
+    global _last_beat_ts
     path = path or heartbeat_path()
     if not path:
         return
-    rec = {"t": "heartbeat", "ts": time.time(), "pid": os.getpid()}
+    now = time.time()
+    rec = {"t": "heartbeat", "ts": now, "pid": os.getpid()}
     if round_idx is not None:
         rec["round"] = int(round_idx)
+    # heartbeat-margin gauge: how close did THIS beat come to the
+    # supervisor's staleness threshold? Gauged on the active telemetry
+    # recorder (rides the next round record) and escalated to a
+    # ``heartbeat_margin`` warning record when the interval ate more than
+    # MARGIN_WARN_FRAC of the timeout — so the classic between-beat
+    # cold-compile gap is visible in the trace before it kills a run.
+    interval = None if _last_beat_ts is None else now - _last_beat_ts
+    _last_beat_ts = now
+    if interval is not None:
+        rec["interval_s"] = round(interval, 3)
+        try:
+            from blades_tpu.telemetry.recorder import get_recorder
+
+            trec = get_recorder()
+            trec.gauge("heartbeat.interval_s", round(interval, 3))
+            timeout = float(os.environ.get(TIMEOUT_ENV) or 0) or None
+            if timeout:
+                trec.gauge("heartbeat.margin_s", round(timeout - interval, 3))
+                if interval >= MARGIN_WARN_FRAC * timeout:
+                    trec.event(
+                        "heartbeat_margin",
+                        interval_s=round(interval, 3),
+                        timeout_s=timeout,
+                        margin_s=round(timeout - interval, 3),
+                        **({"round": int(round_idx)}
+                           if round_idx is not None else {}),
+                    )
+        except Exception:  # noqa: BLE001 - liveness must never raise
+            pass
     try:
         d = os.path.dirname(path)
         if d:

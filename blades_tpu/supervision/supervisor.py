@@ -340,6 +340,10 @@ class Supervisor:
         env.update(self.env)
         env[hb.SUPERVISED_ENV] = "1"
         env[hb.HEARTBEAT_ENV] = self.heartbeat_file
+        if self.heartbeat_timeout_s is not None:
+            # let the workload measure its own margin against the kill
+            # threshold (heartbeat.beat's heartbeat_margin records)
+            env[hb.TIMEOUT_ENV] = str(self.heartbeat_timeout_s)
         applied: List[str] = []
         for policy in self.degrade[: attempt - 1]:
             env.update(policy.env)

@@ -9,8 +9,19 @@ sync vs. eval), what the XLA compilation cache is doing (critical on hosts
 where a cold compile costs minutes), and what the defense actually decided
 (Krum selections, trimmed-mean trim masks, FLTrust trust scores).
 
-Schema and usage: ``docs/observability.md``. Summaries:
-``python scripts/trace_summary.py <trace.jsonl>``.
+Schema and usage: ``docs/observability.md`` + the machine-readable
+``docs/telemetry_schema.json`` (validated by
+:mod:`blades_tpu.telemetry.schema`). Summaries:
+``python scripts/trace_summary.py <trace.jsonl>``; cross-run perf
+trajectory + regression gate: ``python scripts/perf_report.py``.
+
+Import discipline: this package (recorder + schema) is stdlib-only and
+importable before jax — the supervision stack depends on that. The
+jax-importing surfaces live in submodules that are deliberately NOT
+re-exported here: :mod:`blades_tpu.telemetry.metric_pack` (the in-graph
+per-round MetricPack traced through the round/block/streaming scans) and
+:mod:`blades_tpu.telemetry.profiling` (measured program cost/memory
+records, device watermark gauges, guarded ``jax.profiler`` captures).
 """
 
 from blades_tpu.telemetry.recorder import (  # noqa: F401

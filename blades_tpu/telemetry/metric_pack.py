@@ -1,0 +1,246 @@
+"""In-graph per-round metrics: a fixed-shape, jit-traceable ``MetricPack``.
+
+Reference counterpart: none — the reference logs only whole-round loss and
+wall time (``src/blades/simulator.py:453-455``); nothing about the update
+population's *shape* (norm spread, honest-vs-byzantine geometry) survives
+a round there.
+
+Why in-graph: round-block execution (``RoundEngine.run_block``) and the
+streaming client axis (``streaming=True``) fuse R rounds × C chunks into
+one ``lax.scan``ned XLA launch — host-side telemetry spans can no longer
+see inside a round, and the dense ``[K, D]`` update matrix the old
+forensics read may never exist at all. The MetricPack is computed *inside*
+the compiled round body from the same slabs the aggregator consumes,
+carried through the scans as stacked fixed-shape outputs, and unstacked
+on the host into one ``metrics`` telemetry record per round
+(``docs/observability.md``). When disabled the pack is an empty pytree and
+the compiled program is exactly the pre-metrics one (compile count pinned
+in ``tests/test_metric_pack.py``).
+
+Contents per round (all fixed-shape, K/chunk-count static):
+
+- ``norm_q [5]`` — min / q25 / median / q75 / max of the participating
+  rows' L2 update norms;
+- ``norm_hist [NBINS]`` — counts of those norms in fixed log10-spaced
+  bins (absolute edges, so histograms are comparable across rounds, runs
+  and chunkings);
+- ``cos_honest`` / ``cos_byz`` — cosine similarity between the mean
+  honest (resp. byzantine) participating update and the *applied*
+  aggregate (0 when the group is empty: an attack steering the aggregate
+  away from the honest mean shows up here without any host-side matrix);
+- ``n_participants`` / ``n_masked_out`` — rows that entered aggregation
+  vs rows excluded (fault dropout + the non-finite guard);
+- ``slab_absmax [C]`` / ``slab_norm_max [C]`` — per client-chunk extremes
+  of the sanitized slab (``C = client_chunks``): the coordinate-level and
+  row-level blowup detectors that survive streaming execution.
+
+Execution-schedule invariance: the dense path folds the SAME
+:func:`pack_update` over the same padded chunk layout the streaming scan
+uses (``ops/streaming.chunk_layout``), so a seeded run produces identical
+metric content under ``run_round``, ``block_size=N`` and
+``streaming=True`` — bit-exact for the elementwise fields (norms,
+histogram, extremes, counts) and up to documented float re-association
+for the cosine accumulators (``tests/test_metric_pack.py``). Row content
+itself must match for this to hold: key-consuming row-local attacks draw
+per-chunk folded keys under streaming (see ``RoundEngine`` docstring), so
+their rounds agree across dense/block but not bit-for-bit with streaming.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from blades_tpu.ops.streaming import stack_init, stack_write
+
+#: Fixed histogram bin count. Edges are absolute (log10-spaced over
+#: [1e-8, 1e8]) so histograms compare across rounds, runs, and chunkings;
+#: the first/last bins catch underflow/overflow.
+NBINS = 18
+
+#: ``NBINS - 1`` interior edges: 10^-8, 10^-7, ..., 10^8. A NUMPY
+#: constant on purpose: this module is imported by ``core/engine.py`` at
+#: module level, and an import-time ``jnp`` op would initialize the jax
+#: backend before callers can run ``force_virtual_cpu()`` — on this box
+#: that can mean hanging forever on a dead TPU tunnel
+#: (``utils/platform.py``). jnp ops convert it at trace time.
+_EDGES = np.logspace(-8.0, 8.0, NBINS - 1)
+
+
+class MetricPack(NamedTuple):
+    """One round's in-graph metrics (see module docstring)."""
+
+    norm_q: jnp.ndarray  # [5] min/q25/median/q75/max of row update norms
+    norm_hist: jnp.ndarray  # [NBINS] int32 fixed-log-bin norm counts
+    cos_honest: jnp.ndarray  # scalar: cos(mean honest update, applied agg)
+    cos_byz: jnp.ndarray  # scalar: cos(mean byz update, applied agg)
+    n_participants: jnp.ndarray  # scalar int32: rows that entered aggregation
+    n_masked_out: jnp.ndarray  # scalar int32: K - participants
+    slab_absmax: jnp.ndarray  # [C] per-chunk max |coord| of sanitized slab
+    slab_norm_max: jnp.ndarray  # [C] per-chunk max row norm
+
+
+def pack_init(num_chunks: int, dim: int) -> Dict[str, Any]:
+    """Zero fold state for one round's pack (scan-carry friendly)."""
+    return {
+        "sum_honest": jnp.zeros((dim,), jnp.float32),
+        "sum_byz": jnp.zeros((dim,), jnp.float32),
+        "n_honest": jnp.zeros((), jnp.float32),
+        "n_byz": jnp.zeros((), jnp.float32),
+        "slab_absmax": stack_init(num_chunks, ()),
+        "slab_norm_max": stack_init(num_chunks, ()),
+    }
+
+
+def pack_update(
+    carry: Dict[str, Any],
+    slab: jnp.ndarray,
+    mask: jnp.ndarray,
+    byz: jnp.ndarray,
+    chunk_index,
+) -> Tuple[Dict[str, Any], jnp.ndarray]:
+    """Fold one sanitized ``[chunk, D]`` slab into the round's pack state.
+
+    ``slab`` arrives with masked-out rows zeroed (the engine's
+    ``Aggregator._sanitize`` rule), ``mask`` covers fault exclusions AND
+    the padded final chunk, ``byz`` is the chunk's slice of the global
+    byzantine mask. Returns the updated carry and the chunk's ``[chunk]``
+    row norms (masked rows report 0) for stacking — ``[K]`` scalars are
+    cheap at any K, so quantiles/histograms stay exact under streaming.
+    """
+    m = mask.astype(jnp.float32)
+    w_h = m * (~byz).astype(jnp.float32)
+    w_b = m * byz.astype(jnp.float32)
+    norms = jnp.sqrt(jnp.maximum(jnp.sum(slab * slab, axis=1), 0.0)) * m
+    carry = {
+        "sum_honest": carry["sum_honest"] + jnp.sum(slab * w_h[:, None], axis=0),
+        "sum_byz": carry["sum_byz"] + jnp.sum(slab * w_b[:, None], axis=0),
+        "n_honest": carry["n_honest"] + jnp.sum(w_h),
+        "n_byz": carry["n_byz"] + jnp.sum(w_b),
+        "slab_absmax": stack_write(
+            carry["slab_absmax"], chunk_index, jnp.max(jnp.abs(slab))
+        ),
+        "slab_norm_max": stack_write(
+            carry["slab_norm_max"], chunk_index, jnp.max(norms)
+        ),
+    }
+    return carry, norms
+
+
+def _masked_quantiles(norms: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """min/q25/median/q75/max over the valid entries of ``norms [K]``.
+
+    The participant count is traced (fault masks), so the quantile
+    positions index into an ascending sort with invalid entries pushed to
+    ``+inf``; an empty round reports zeros.
+    """
+    n = jnp.sum(valid.astype(jnp.int32))
+    s = jnp.sort(jnp.where(valid, norms, jnp.inf))
+    nf = jnp.maximum(n.astype(jnp.float32) - 1.0, 0.0)
+    idx = jnp.floor(jnp.asarray([0.0, 0.25, 0.5, 0.75, 1.0]) * nf).astype(
+        jnp.int32
+    )
+    q = s[jnp.clip(idx, 0, s.shape[0] - 1)]
+    return jnp.where(n > 0, q, jnp.zeros_like(q))
+
+
+def pack_finalize(
+    carry: Dict[str, Any],
+    norms: jnp.ndarray,
+    valid: jnp.ndarray,
+    agg: jnp.ndarray,
+) -> MetricPack:
+    """Close the fold into a :class:`MetricPack`.
+
+    ``norms``/``valid`` are the unchunked ``[K]`` row norms and
+    participation mask; ``agg`` is the aggregate the server APPLIED
+    (post-audit-fallback), so the cosines measure what actually steered
+    the model.
+    """
+    n = jnp.sum(valid.astype(jnp.int32))
+    bins = jnp.searchsorted(_EDGES, jnp.where(valid, norms, -1.0))
+    hist = jnp.zeros((NBINS,), jnp.int32).at[bins].add(
+        valid.astype(jnp.int32)
+    )
+
+    def _cos(vec_sum, count):
+        mean = vec_sum / jnp.maximum(count, 1.0)
+        denom = jnp.linalg.norm(mean) * jnp.linalg.norm(agg)
+        cos = jnp.where(denom > 0.0, jnp.dot(mean, agg) / denom, 0.0)
+        return jnp.where(count > 0.0, cos, 0.0)
+
+    return MetricPack(
+        norm_q=_masked_quantiles(norms, valid),
+        norm_hist=hist,
+        cos_honest=_cos(carry["sum_honest"], carry["n_honest"]),
+        cos_byz=_cos(carry["sum_byz"], carry["n_byz"]),
+        n_participants=n,
+        n_masked_out=jnp.asarray(valid.shape[0], jnp.int32) - n,
+        slab_absmax=carry["slab_absmax"],
+        slab_norm_max=carry["slab_norm_max"],
+    )
+
+
+def pack_dense(
+    updates: jnp.ndarray,
+    mask: jnp.ndarray,
+    byz_mask: jnp.ndarray,
+    agg: jnp.ndarray,
+    num_chunks: int,
+    chunk_size: int,
+) -> MetricPack:
+    """The dense round body's pack: fold :func:`pack_update` over the SAME
+    padded chunk layout the streaming scan walks (``chunk_layout``), so a
+    dense and a streaming execution of identical rows produce identical
+    metric content (module docstring). ``updates`` is the post-fault
+    matrix the defense consumed; masked-out rows are zeroed here exactly
+    as ``Aggregator._sanitize`` zeroes them on the streaming path.
+    """
+    k = updates.shape[0]
+    pad = num_chunks * chunk_size - k
+
+    def chunked(a):
+        if pad:
+            a = jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+        return a.reshape((num_chunks, chunk_size) + a.shape[1:])
+
+    mask = jnp.asarray(mask).astype(bool)
+    safe = jnp.where(mask[:, None], updates, 0.0)
+    slabs = chunked(safe)
+    masks = chunked(mask)
+    byzs = chunked(byz_mask)
+
+    carry = pack_init(num_chunks, updates.shape[1])
+    norm_chunks = []
+    # Python loop over the STATIC chunk count: unrolled at trace time into
+    # the same per-chunk fold order as the streaming lax.scan (sequential
+    # adds — not a tree reduction — so the cosine accumulators associate
+    # identically too)
+    for j in range(num_chunks):
+        carry, nj = pack_update(carry, slabs[j], masks[j], byzs[j], j)
+        norm_chunks.append(nj)
+    norms = jnp.concatenate(norm_chunks)[:k]
+    valid = mask
+    return pack_finalize(carry, norms, valid, agg)
+
+
+def pack_to_fields(pack: MetricPack) -> Dict[str, Any]:
+    """Host-side: one pack -> the JSON-ready field dict of a ``metrics``
+    telemetry record (``docs/telemetry_schema.json``)."""
+    q = np.asarray(pack.norm_q, dtype=np.float64)
+    return {
+        "norm_min": float(q[0]),
+        "norm_q25": float(q[1]),
+        "norm_median": float(q[2]),
+        "norm_q75": float(q[3]),
+        "norm_max": float(q[4]),
+        "norm_hist": np.asarray(pack.norm_hist).astype(int).tolist(),
+        "cos_honest": float(pack.cos_honest),
+        "cos_byz": float(pack.cos_byz),
+        "participants": int(pack.n_participants),
+        "masked_out": int(pack.n_masked_out),
+        "slab_absmax": np.asarray(pack.slab_absmax, np.float64).tolist(),
+        "slab_norm_max": np.asarray(pack.slab_norm_max, np.float64).tolist(),
+    }

@@ -1,0 +1,210 @@
+"""Measured memory & program profiles: cost-analysis records, device
+memory watermarks, and guarded ``jax.profiler`` captures.
+
+Reference counterpart: none — the reference never measures its own
+programs (``src/blades/simulator.py:453-455`` logs wall time only).
+
+Three measurement surfaces, all best-effort by construction (this repo
+runs across jaxlib builds and attachment modes that expose different
+subsets — a missing API must degrade to a no-op, never fail the run):
+
+- :func:`record_program_profile` — lower+compile the exact program a run
+  executes (a persistent-cache hit on any warm host, ``utils/xla_cache``)
+  and emit ONE ``memory`` telemetry record per program: XLA cost-model
+  flops / bytes accessed plus, where the backend implements
+  ``memory_analysis``, the compiled buffer budget (temp / argument /
+  output / generated-code bytes). This puts a *measured* number next to
+  the engine's analytical ``peak_update_bytes`` gauge in the same trace.
+- :func:`record_live_bytes` — ``device.memory_stats()`` watermarks
+  (``bytes_in_use`` / ``peak_bytes_in_use``) as ``mem.*`` gauges, riding
+  the next ``round`` record; cheap enough for block boundaries. The CPU
+  backend reports no stats — gauges simply don't appear there.
+- :func:`start_capture` / :func:`stop_capture` — programmatic
+  ``jax.profiler`` trace of the timed region (``BLADES_PROFILE=<dir>``;
+  the xprof/tensorboard-viewable capture). Each start/stop lands as a
+  ``profile`` telemetry record with ``ok`` or the degradation reason, so
+  a trace that silently failed to capture is visible in the run's own
+  telemetry instead of being discovered at analysis time.
+
+Schema of the ``memory``/``profile`` records: ``docs/telemetry_schema.json``
+(+ docs/observability.md). Import note: this module imports jax — it is
+deliberately NOT re-exported from ``blades_tpu.telemetry`` so the recorder
+(and the supervision stack that embeds it) stays importable before jax.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from blades_tpu.telemetry.recorder import Recorder, get_recorder
+
+#: Env knob: directory for a programmatic ``jax.profiler`` capture of the
+#: timed region (``Simulator.run`` and ``bench.py`` both honor it).
+PROFILE_ENV = "BLADES_PROFILE"
+
+#: Env kill-switch for per-program cost/memory records (on by default
+#: whenever telemetry itself is on; the lowering re-trace is once per
+#: program but a cold host without the persistent XLA cache may prefer 0).
+PROGRAM_PROFILE_ENV = "BLADES_PROGRAM_PROFILE"
+
+
+def profile_dir_from_env() -> Optional[str]:
+    """The capture directory (``BLADES_PROFILE``, with the older
+    ``BLADES_TELEMETRY_PROFILE_DIR`` alias), or None."""
+    return (
+        os.environ.get(PROFILE_ENV)
+        or os.environ.get("BLADES_TELEMETRY_PROFILE_DIR")
+        or None
+    )
+
+
+def program_profile_enabled() -> bool:
+    return os.environ.get(PROGRAM_PROFILE_ENV, "1") != "0"
+
+
+def _first(obj):
+    return obj[0] if isinstance(obj, (list, tuple)) and obj else obj
+
+
+def cost_fields(compiled) -> Dict[str, Any]:
+    """Flops / bytes-accessed / memory-analysis fields of a
+    ``jax.stages.Compiled``; whatever the backend doesn't expose is simply
+    absent from the dict."""
+    fields: Dict[str, Any] = {}
+    try:
+        ca = _first(compiled.cost_analysis())
+        if ca:
+            for src, dst in (
+                ("flops", "flops"),
+                ("bytes accessed", "bytes_accessed"),
+                ("optimal_seconds", "optimal_seconds"),
+            ):
+                v = ca.get(src)
+                if v is not None and float(v) > 0:
+                    fields[dst] = float(v)
+    except Exception:  # noqa: BLE001 - cost model is optional per backend
+        pass
+    try:
+        ma = _first(compiled.memory_analysis())
+        if ma is not None:
+            for attr in (
+                "temp_size_in_bytes",
+                "argument_size_in_bytes",
+                "output_size_in_bytes",
+                "alias_size_in_bytes",
+                "generated_code_size_in_bytes",
+            ):
+                v = getattr(ma, attr, None)
+                if v is not None:
+                    fields[attr.replace("_size_in_bytes", "_bytes")] = int(v)
+    except Exception:  # noqa: BLE001 - memory_analysis is optional too
+        pass
+    return fields
+
+
+def record_program_profile(
+    name: str, jitted, *args, rec: Optional[Recorder] = None, **kwargs
+) -> Optional[Dict[str, Any]]:
+    """Emit one ``memory`` record for the program ``jitted(*args)`` runs.
+
+    Lower+compile on the exact argument pytree the caller executes with —
+    after a first real call this is a jaxpr re-trace plus a PERSISTENT-
+    compilation-cache hit (the jit call that just ran wrote the entry),
+    never a second backend compile. The AOT path cannot see the jit's
+    in-memory executable, so when the persistent cache is OFF this would
+    genuinely recompile — a round-scale compile costs minutes on this
+    box, inside the supervised between-heartbeat window — so the profile
+    is skipped whenever no cache is ACTUALLY active (the live
+    ``jax_compilation_cache_dir`` config, which ``enable_compilation_cache``
+    leaves unset on ``BLADES_TPU_NO_CACHE=1`` *and* when the cache dir
+    turned out unwritable). Returns the recorded field dict (None when
+    skipped, nothing was measurable, or the recorder is disabled). Never
+    raises.
+    """
+    rec = rec or get_recorder()
+    if not rec.enabled or not program_profile_enabled():
+        return None
+    try:
+        import jax
+
+        if not jax.config.jax_compilation_cache_dir:
+            return None
+    except Exception:  # noqa: BLE001 - no config knob == can't prove a cache
+        return None
+    try:
+        compiled = jitted.lower(*args, **kwargs).compile()
+        fields = cost_fields(compiled)
+        if not fields:
+            return None
+        rec.event("memory", program=name, **fields)
+        return fields
+    except Exception:  # noqa: BLE001 - observability must not fail the run
+        return None
+
+
+def memory_stats(device=None) -> Optional[Dict[str, int]]:
+    """``device.memory_stats()`` of the first (or given) device, or None
+    when the backend doesn't implement it (CPU) or errors."""
+    try:
+        import jax
+
+        device = device or jax.devices()[0]
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001
+        return None
+    if not stats:
+        return None
+    return {k: int(v) for k, v in stats.items() if isinstance(v, (int, float))}
+
+
+def record_live_bytes(rec: Optional[Recorder] = None, device=None) -> None:
+    """Gauge the device's live/peak byte watermarks (``mem.bytes_in_use``,
+    ``mem.peak_bytes_in_use``) so they ride the next ``round`` record.
+    No-op where the backend has no allocator stats."""
+    rec = rec or get_recorder()
+    if not rec.enabled:
+        return
+    stats = memory_stats(device)
+    if not stats:
+        return
+    for key in ("bytes_in_use", "peak_bytes_in_use", "largest_alloc_size"):
+        if key in stats:
+            rec.gauge(f"mem.{key}", stats[key])
+
+
+def start_capture(profile_dir: str, rec: Optional[Recorder] = None) -> bool:
+    """Start a programmatic profiler trace into ``profile_dir``; returns
+    whether a capture is actually running. Degrades to a no-op (with a
+    ``profile`` record naming the reason) on backends/attachment modes
+    where tracing is unavailable."""
+    rec = rec or get_recorder()
+    try:
+        import jax
+
+        jax.profiler.start_trace(profile_dir)
+    except Exception as e:  # noqa: BLE001
+        rec.event(
+            "profile", action="start", dir=profile_dir, ok=False,
+            error=f"{type(e).__name__}: {e}"[:300],
+        )
+        return False
+    rec.event("profile", action="start", dir=profile_dir, ok=True)
+    return True
+
+
+def stop_capture(profile_dir: str, rec: Optional[Recorder] = None) -> bool:
+    """Stop a capture started by :func:`start_capture`; same guarantees."""
+    rec = rec or get_recorder()
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception as e:  # noqa: BLE001
+        rec.event(
+            "profile", action="stop", dir=profile_dir, ok=False,
+            error=f"{type(e).__name__}: {e}"[:300],
+        )
+        return False
+    rec.event("profile", action="stop", dir=profile_dir, ok=True)
+    return True

@@ -1,0 +1,139 @@
+"""Telemetry schema lint: validate a trace against the committed schema.
+
+The JSONL record types (``docs/observability.md``) used to live only in
+prose — a field renamed in code drifted silently until some consumer
+(trace_summary, chaos invariants, perf_report) mis-parsed a trace weeks
+later. The machine-readable schema (``docs/telemetry_schema.json``) plus
+this validator make drift fail fast: a tier-1 test runs a real Simulator
+round and validates every record it wrote
+(``tests/test_telemetry.py``); an UNKNOWN record type or an undeclared
+field on a closed (``"extra": false``) type is an error, so adding a
+record type forces the schema (and therefore the docs) to move with it.
+
+Stdlib-only, like the recorder. Usage::
+
+    python -m blades_tpu.telemetry.schema <trace.jsonl>   # exit 1 on drift
+
+Reference counterpart: none — the reference's flat ``stats`` file has no
+schema to drift from (``src/blades/utils.py:67-95``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+#: The committed schema next to docs/observability.md.
+SCHEMA_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "docs",
+    "telemetry_schema.json",
+)
+
+_CHECKS = {
+    "int": lambda v: isinstance(v, int) and not isinstance(v, bool),
+    "number": lambda v: isinstance(v, (int, float)) and not isinstance(v, bool),
+    "string": lambda v: isinstance(v, str),
+    "bool": lambda v: isinstance(v, bool),
+    "array": lambda v: isinstance(v, list),
+    "object": lambda v: isinstance(v, dict),
+}
+
+
+def load_schema(path: Optional[str] = None) -> Dict[str, Any]:
+    with open(path or SCHEMA_PATH) as f:
+        return json.load(f)
+
+
+def validate_record(rec: Dict[str, Any], schema: Dict[str, Any]) -> List[str]:
+    """Errors for one parsed record (empty list == valid)."""
+    t = rec.get("t")
+    if not isinstance(t, str):
+        return [f"record has no string 't' field: {rec!r:.120}"]
+    spec = schema["types"].get(t)
+    if spec is None:
+        return [
+            f"unknown record type {t!r} — add it to docs/telemetry_schema.json"
+            " (and docs/observability.md)"
+        ]
+    errors = []
+    for field, ftype in spec.get("required", {}).items():
+        if field not in rec:
+            errors.append(f"{t}: missing required field {field!r}")
+        elif not _CHECKS[ftype](rec[field]):
+            errors.append(
+                f"{t}.{field}: expected {ftype}, got "
+                f"{type(rec[field]).__name__} ({rec[field]!r:.60})"
+            )
+    for field, ftype in spec.get("optional", {}).items():
+        if field in rec and not _CHECKS[ftype](rec[field]):
+            errors.append(
+                f"{t}.{field}: expected {ftype}, got "
+                f"{type(rec[field]).__name__} ({rec[field]!r:.60})"
+            )
+    if not spec.get("extra", True):
+        declared = (
+            {"t"} | set(spec.get("required", {})) | set(spec.get("optional", {}))
+        )
+        for field in rec:
+            if field not in declared:
+                errors.append(
+                    f"{t}: undeclared field {field!r} on a closed type — "
+                    "declare it in docs/telemetry_schema.json"
+                )
+    return errors
+
+
+def validate_records(
+    records, schema: Optional[Dict[str, Any]] = None
+) -> List[str]:
+    """Errors across a record list, each prefixed with its index."""
+    schema = schema or load_schema()
+    errors = []
+    for i, rec in enumerate(records):
+        for e in validate_record(rec, schema):
+            errors.append(f"[{i}] {e}")
+    return errors
+
+
+def validate_trace(path: str, schema: Optional[Dict[str, Any]] = None) -> List[str]:
+    """Errors for a telemetry.jsonl file (skips blank/torn lines, same
+    tolerance as ``trace_summary.load_records`` — a live run may be
+    mid-write)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    if not records:
+        # a lint that validates nothing must not pass: an empty/corrupt
+        # trace is drift too (trace_summary treats it as an error as well)
+        return [f"no parseable JSONL records in {path}"]
+    return validate_records(records, schema)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("trace", help="path to a telemetry .jsonl file")
+    p.add_argument("--schema", default=None, help="override schema path")
+    args = p.parse_args(argv)
+    errors = validate_trace(args.trace, load_schema(args.schema))
+    if errors:
+        for e in errors:
+            print(e)
+        print(f"{len(errors)} schema violation(s) in {args.trace}")
+        return 1
+    print(f"{args.trace}: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -59,6 +59,7 @@ GALLERY = [
      {}, 900),
     ("simulation_on_mnist.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
     ("telemetry_trace.py", ["--rounds", "2", "--out", "@TMP@"], {}, 600),
+    ("metrics_trace.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
     ("fault_injection.py",
      ["--rounds", "2", "--out", "@TMP@", "--aggs", "median"], {}, 900),
     ("defense_audit.py", ["--rounds", "2", "--out", "@TMP@"], {}, 900),
@@ -79,6 +80,10 @@ GALLERY = [
 
 API_MODULES = [
     "blades_tpu",
+    "blades_tpu.telemetry",
+    "blades_tpu.telemetry.metric_pack",
+    "blades_tpu.telemetry.profiling",
+    "blades_tpu.telemetry.schema",
     "blades_tpu.simulator",
     "blades_tpu.client",
     "blades_tpu.server",

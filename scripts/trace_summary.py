@@ -14,9 +14,12 @@ time (``src/blades/simulator.py:453-455``), so it has nothing to summarize.
 Usage::
 
     python scripts/trace_summary.py outputs/telemetry.jsonl [--json]
+    python scripts/trace_summary.py --compare A.jsonl B.jsonl
 
 ``--json`` emits the summary dict instead of the table (machine-readable,
-used by tests).
+used by tests). ``--compare`` diffs two runs' per-stage cost tables and
+compile/cache counters side by side — the manual two-terminal workflow of
+every perf PR so far, as one command.
 """
 
 from __future__ import annotations
@@ -51,6 +54,10 @@ def summarize(records: List[dict]) -> dict:
     compiles = []
     defenses = []
     audits = []
+    metrics = []
+    programs = []
+    profile_events = []
+    margins = []
     supervisor: Dict[str, int] = {}
     kill_reasons = []
     meta = {}
@@ -73,6 +80,14 @@ def summarize(records: List[dict]) -> dict:
             defenses.append(r)
         elif t == "audit":
             audits.append(r)
+        elif t == "metrics":
+            metrics.append(r)
+        elif t == "memory":
+            programs.append(r)
+        elif t == "profile":
+            profile_events.append(r)
+        elif t == "heartbeat_margin":
+            margins.append(r)
         elif t == "supervisor":
             ev = r.get("event", "?")
             supervisor[ev] = supervisor.get(ev, 0) + 1
@@ -164,12 +179,67 @@ def summarize(records: List[dict]) -> dict:
                     "engine.chunk_size"):
             if key in last_gauges:
                 memory_summary[key.split(".", 1)[1]] = last_gauges[key]
+    # MEASURED allocator watermarks (mem.* gauges, profiling.py) next to
+    # the analytical estimate — absent on backends without memory_stats
+    live_vals = [
+        r["gauges"]["mem.peak_bytes_in_use"]
+        for r in rounds
+        if "mem.peak_bytes_in_use" in (r.get("gauges") or {})
+    ]
+    if live_vals:
+        memory_summary["measured_peak_bytes_in_use"] = max(live_vals)
+
+    # in-graph round metrics (`metrics` records, telemetry/metric_pack.py):
+    # honest/byz geometry means + worst-round extremes
+    metrics_summary: Dict[str, float] = {}
+    if metrics:
+        metrics_summary["rounds"] = len(metrics)
+        for key in ("cos_honest", "cos_byz"):
+            vals = [m[key] for m in metrics if key in m]
+            if vals:
+                metrics_summary[f"mean_{key}"] = sum(vals) / len(vals)
+        medians = [m["norm_median"] for m in metrics if "norm_median" in m]
+        if medians:
+            metrics_summary["max_norm_median"] = max(medians)
+        excl = [m.get("masked_out", 0) for m in metrics]
+        metrics_summary["max_masked_out"] = max(excl) if excl else 0
+
+    # measured program profiles (`memory` records): cost-model flops /
+    # bytes + compiled buffer budget per program, next to the analytical
+    # peak_update_bytes gauge above
+    program_summary: Dict[str, dict] = {}
+    for p in programs:
+        name = p.get("program", "?")
+        program_summary[name] = {
+            k: v for k, v in p.items() if k not in ("t", "program")
+        }
+
+    # heartbeat margin (supervision.heartbeat + BLADES_HEARTBEAT_TIMEOUT):
+    # how close beats came to the supervisor's kill threshold
+    heartbeat_summary: Dict[str, float] = {}
+    intervals = [
+        r["gauges"]["heartbeat.interval_s"]
+        for r in rounds
+        if "heartbeat.interval_s" in (r.get("gauges") or {})
+    ]
+    if intervals:
+        heartbeat_summary["max_interval_s"] = max(intervals)
+    if margins:
+        heartbeat_summary["warnings"] = len(margins)
+        heartbeat_summary["min_margin_s"] = min(
+            m["margin_s"] for m in margins
+        )
+        heartbeat_summary["timeout_s"] = margins[-1].get("timeout_s")
 
     return {
         "meta": meta,
         "spans": spans,
         "counters": counters,
         "memory": memory_summary,
+        "metrics": metrics_summary,
+        "programs": program_summary,
+        "heartbeat": heartbeat_summary,
+        "profile_events": len(profile_events),
         "block": block_summary,
         "rounds": {
             "count": len(rounds),
@@ -255,6 +325,42 @@ def format_table(summary: dict) -> str:
             f"memory: peak_update_bytes={mem['peak_update_bytes']:.0f} "
             f"({mb:.1f} MB{', ' + extras if extras else ''})"
         )
+    progs = summary.get("programs") or {}
+    for name, p in sorted(progs.items()):
+        pairs = ", ".join(
+            f"{k}={v:.3g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(p.items())
+        )
+        lines.append(f"program[{name}]: {pairs}")
+    met = summary.get("metrics") or {}
+    if met:
+        pairs = ", ".join(
+            f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(met.items())
+        )
+        lines.append(f"metrics: {pairs}")
+    hb = summary.get("heartbeat") or {}
+    if hb:
+        pairs = ", ".join(
+            f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(hb.items())
+        )
+        lines.append(f"heartbeat: {pairs}")
+        if hb.get("warnings"):
+            # the emission threshold lives with the emitter (stdlib-safe
+            # import) — the hint must not drift from what triggered it;
+            # fall back to the shipped value when run standalone outside
+            # the repo root
+            try:
+                from blades_tpu.supervision.heartbeat import MARGIN_WARN_FRAC
+            except ImportError:
+                MARGIN_WARN_FRAC = 0.75
+            lines.append(
+                f"  WARNING: {hb['warnings']} beat(s) landed within "
+                f"{(1 - MARGIN_WARN_FRAC) * 100:.0f}% of the supervisor "
+                f"timeout (min margin {hb['min_margin_s']:.1f}s) — raise "
+                "--heartbeat-timeout or shrink the block"
+            )
     if summary["defense"]:
         pairs = ", ".join(
             f"{k}={v:.3f}" for k, v in sorted(summary["defense"].items())
@@ -276,15 +382,98 @@ def format_table(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def compare_format(sa: dict, sb: dict, la: str = "A", lb: str = "B") -> str:
+    """Side-by-side per-stage cost + counter diff of two runs — the
+    workflow every perf PR so far ran by eyeballing two terminals."""
+    lines = []
+    lines.append(f"A = {la}")
+    lines.append(f"B = {lb}")
+    ra, rb = sa["rounds"], sb["rounds"]
+    lines.append(
+        f"{'':<28}{'A':>12}{'B':>12}{'B/A':>8}\n"
+        f"{'rounds':<28}{ra['count']:>12}{rb['count']:>12}"
+    )
+
+    def ratio(a, b):
+        return f"{b / a:>8.2f}" if a else f"{'—':>8}"
+
+    lines.append(
+        f"{'mean round wall (ms)':<28}{ra['mean_wall_s'] * 1e3:>12.1f}"
+        f"{rb['mean_wall_s'] * 1e3:>12.1f}"
+        f"{ratio(ra['mean_wall_s'], rb['mean_wall_s'])}"
+    )
+    # per-stage: per-ROUND mean seconds so block-vs-round traces compare
+    paths = sorted(set(sa["spans"]) | set(sb["spans"]))
+
+    def per_round(s, path):
+        sp = s["spans"].get(path)
+        n = s["rounds"]["count"] or 1
+        return sp["total_s"] / n if sp else None
+
+    for path in paths:
+        va, vb = per_round(sa, path), per_round(sb, path)
+        fa = f"{va * 1e3:>12.1f}" if va is not None else f"{'—':>12}"
+        fb = f"{vb * 1e3:>12.1f}" if vb is not None else f"{'—':>12}"
+        rr = ratio(va, vb) if va is not None and vb is not None else f"{'—':>8}"
+        lines.append(f"{path + ' (ms/rnd)':<28}{fa}{fb}{rr}")
+    keys = sorted(set(sa["counters"]) | set(sb["counters"]))
+    for k in keys:
+        va, vb = sa["counters"].get(k, 0), sb["counters"].get(k, 0)
+        fmt = (
+            (lambda v: f"{v:>12.3f}")
+            if isinstance(va, float) or isinstance(vb, float)
+            else (lambda v: f"{v:>12}")
+        )
+        lines.append(f"{k:<28}{fmt(va)}{fmt(vb)}{ratio(va, vb)}")
+    ca, cb = sa["compiles"], sb["compiles"]
+    lines.append(
+        f"{'compiles':<28}{ca['count']:>12}{cb['count']:>12}"
+        f"{ratio(ca['count'], cb['count'])}"
+    )
+    ma = (sa.get("memory") or {}).get("peak_update_bytes")
+    mb = (sb.get("memory") or {}).get("peak_update_bytes")
+    if ma is not None or mb is not None:
+        fa = f"{ma:>12.0f}" if ma is not None else f"{'—':>12}"
+        fb = f"{mb:>12.0f}" if mb is not None else f"{'—':>12}"
+        rr = ratio(ma, mb) if ma and mb is not None else f"{'—':>8}"
+        lines.append(f"{'peak_update_bytes':<28}{fa}{fb}{rr}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("trace", help="path to a telemetry .jsonl file")
+    p.add_argument("trace", nargs="+",
+                   help="path to a telemetry .jsonl file (two with --compare)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit the summary dict as JSON instead of a table")
+    p.add_argument("--compare", action="store_true",
+                   help="diff two traces' cost tables and counters "
+                        "side by side")
     args = p.parse_args(argv)
-    records = load_records(args.trace)
+    if args.compare:
+        if len(args.trace) != 2:
+            print("--compare needs exactly two trace paths", file=sys.stderr)
+            return 2
+        summaries = []
+        for path in args.trace:
+            records = load_records(path)
+            if not records:
+                print(f"no records in {path}", file=sys.stderr)
+                return 1
+            summaries.append(summarize(records))
+        if args.as_json:
+            print(json.dumps({"a": summaries[0], "b": summaries[1]}))
+        else:
+            print(compare_format(*summaries, la=args.trace[0],
+                                 lb=args.trace[1]))
+        return 0
+    if len(args.trace) != 1:
+        print("exactly one trace path expected (or use --compare A B)",
+              file=sys.stderr)
+        return 2
+    records = load_records(args.trace[0])
     if not records:
-        print(f"no records in {args.trace}", file=sys.stderr)
+        print(f"no records in {args.trace[0]}", file=sys.stderr)
         return 1
     summary = summarize(records)
     if args.as_json:

@@ -1,0 +1,145 @@
+"""Cross-run perf-report tests: the one-JSON-line contract, reproduction
+of the committed PR 5/PR 6 headline numbers from the artifacts the repo
+already carries, and the --check regression gate (pass on the committed
+baseline, fail on a synthetic regression).
+
+Reference counterpart: none — the reference publishes no numbers
+(BASELINE.md) and has no cross-run tooling at all.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import perf_report  # noqa: E402
+
+
+def _run_cli(args):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_report.py"),
+         *args],
+        capture_output=True, text=True, timeout=120,
+    )
+    return proc
+
+
+def test_one_json_line_and_committed_numbers():
+    """CLI contract + acceptance: exactly one stdout line, parseable, and
+    it reproduces the PR 5 block speedup (2.72x) and the PR 6 streaming
+    evidence (dense K=10^4 OOM, 82.5 MB streaming peak) from the
+    committed artifacts."""
+    proc = _run_cli([])
+    assert proc.returncode == 0, proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1
+    payload = json.loads(lines[0])
+    assert payload["metric"] == "perf_report"
+    assert payload["rows"] >= 10  # the committed artifact set is rich
+    assert payload["block_speedup"] == pytest.approx(2.72, abs=0.01)
+    assert payload["dense_oom_at_k10000"] is True
+    assert payload["streaming_k10000_peak_update_bytes"] == 82512800
+    assert payload["headline_tpu_rps"] == pytest.approx(1.2556)
+    assert payload["ok"] is True
+
+
+def test_check_passes_on_committed_baseline():
+    proc = _run_cli(["--check"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout.strip())
+    assert payload["regressions"] == [] and payload["ok"] is True
+    assert payload["checked_against"].endswith("baseline.json")
+
+
+def test_check_fails_on_synthetic_regression(tmp_path):
+    """Acceptance: --check exits nonzero on a regressed input — the
+    committed block pair with block64 throughput collapsed."""
+    rb = tmp_path / "results" / "round_block"
+    rb.mkdir(parents=True)
+    for name in ("block1.json", "block64.json"):
+        payload = json.load(
+            open(os.path.join(REPO, "results", "round_block", name))
+        )
+        if name == "block64.json":
+            payload["rounds_per_sec"] = payload["rounds_per_sec"] / 3.0
+        json.dump(payload, open(rb / name, "w"))
+    proc = _run_cli([
+        "--repo", str(tmp_path), "--check",
+        "--baseline",
+        os.path.join(REPO, "results", "perf_report", "baseline.json"),
+    ])
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout.strip())
+    assert payload["ok"] is False
+    assert any("rounds_per_sec" in r for r in payload["regressions"])
+    assert any("block_speedup" in r for r in payload["regressions"])
+    # a missing baseline is an explicit failure, not a silent pass
+    proc = _run_cli(["--repo", str(tmp_path), "--check",
+                     "--baseline", str(tmp_path / "nope.json")])
+    assert proc.returncode == 1
+    assert "no baseline" in json.loads(proc.stdout.strip())["regressions"][0]
+
+
+def test_markdown_and_artifacts_out(tmp_path):
+    proc = _run_cli(["--out", str(tmp_path / "pr"), "--markdown"])
+    assert proc.returncode == 0
+    assert "| run |" in proc.stderr  # table on stderr, never stdout
+    md = open(tmp_path / "pr" / "trajectory.md").read()
+    assert "round_block/block64" in md and "block_speedup" in md
+    report = json.load(open(tmp_path / "pr" / "report.json"))
+    assert report["block_speedup"] == pytest.approx(2.72, abs=0.01)
+    assert any(
+        r["name"] == "streaming_k/k10000_streaming_16gib"
+        for r in report["trajectory"]
+    )
+
+
+def test_trace_ingestion(tmp_path):
+    """A per-run telemetry.jsonl folds into the trajectory with
+    rounds/sec from the round walls, compile counters and peak bytes."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from blades_tpu.telemetry import Recorder
+
+    log = tmp_path / "myrun"
+    log.mkdir()
+    rec = Recorder(enabled=True, path=str(log / "telemetry.jsonl"))
+    rec.counter("xla.compiles", 4)
+    rec.gauge("engine.peak_update_bytes", 5000)
+    for rnd in (1, 2):
+        rec.round_record(rnd, wall_s=0.5)
+    rec.close()
+    rows = perf_report.ingest_traces([str(log / "telemetry.jsonl")])
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["name"] == "trace/myrun"
+    assert row["rounds_per_sec"] == pytest.approx(2.0)
+    assert row["compiles"] == 4 and row["peak_update_bytes"] == 5000
+
+
+def test_committed_trajectory_artifacts_fresh():
+    """The committed results/perf_report/ artifacts exist and agree with
+    a fresh in-process report over the same repo (the trajectory is
+    regenerable, not hand-typed)."""
+    report = perf_report.build_report(REPO, [])
+    derived = report["derived"]
+    committed = json.load(
+        open(os.path.join(REPO, "results", "perf_report", "report.json"))
+    )
+    assert committed["block_speedup"] == derived["block_speedup"]
+    assert (
+        committed["streaming_k10000_peak_update_bytes"]
+        == derived["streaming_k10000_peak_update_bytes"]
+    )
+    baseline = json.load(
+        open(os.path.join(REPO, "results", "perf_report", "baseline.json"))
+    )
+    assert baseline["derived"]["block_speedup"] == derived["block_speedup"]
+    # the docs section was regenerated from the same data
+    docs = open(os.path.join(REPO, "docs", "performance.md")).read()
+    assert perf_report.DOCS_BEGIN in docs
+    assert "`block_speedup` = 2.72" in docs

@@ -354,6 +354,183 @@ def test_trace_summary_cli_main(tmp_path, capsys):
     assert trace_summary.main([empty]) == 1  # no records -> error exit
 
 
+def test_real_trace_validates_against_schema(tmp_path):
+    """Schema lint acceptance: every record a REAL run writes (spans,
+    rounds, compiles, defense forensics, in-graph metrics, the measured
+    program profile) validates against docs/telemetry_schema.json —
+    record drift fails here, not in a consumer weeks later."""
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import Synthetic
+    from blades_tpu.telemetry.schema import load_schema, validate_records
+
+    ds = Synthetic(num_clients=6, train_size=240, test_size=60, cache=False)
+    log = str(tmp_path / "out")
+    sim = Simulator(ds, log_path=log, seed=0, aggregator="trimmedmean",
+                    aggregator_kws={"num_byzantine": 2},
+                    num_byzantine=2, attack="signflipping")
+    sim.run("mlp", global_rounds=2, local_steps=1, train_batch_size=8,
+            validate_interval=2, collect_diagnostics=True,
+            round_metrics=True,
+            fault_model={"dropout_rate": 0.3})
+    records = load_records(os.path.join(log, "telemetry.jsonl"))
+    types = {r["t"] for r in records}
+    # the new record families are actually present in what we validated
+    assert {"metrics", "memory", "round", "span", "faults"} <= types
+    assert validate_records(records) == []
+
+    # drift detection: unknown types and undeclared fields on closed
+    # types are errors
+    schema = load_schema()
+    errs = validate_records(
+        [{"t": "brand_new_record"}, {"t": "faults", "round": 1}], schema
+    )
+    assert any("unknown record type" in e for e in errs)
+    assert any("missing required" in e for e in errs)
+    errs = validate_records(
+        [{"t": "run_end", "rounds_completed": 1, "surprise": 2}], schema
+    )
+    assert any("undeclared field" in e for e in errs)
+
+
+def test_schema_cli_main(tmp_path, capsys):
+    from blades_tpu.telemetry import schema as schema_mod
+
+    good = tmp_path / "good.jsonl"
+    good.write_text('{"t": "compile", "dur_s": 1.5}\n')
+    assert schema_mod.main([str(good)]) == 0
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"t": "nope"}\n')
+    assert schema_mod.main([str(bad)]) == 1
+    assert "unknown record type" in capsys.readouterr().out
+    # a lint that validated nothing must not pass
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("not json at all\n")
+    assert schema_mod.main([str(empty)]) == 1
+    assert "no parseable" in capsys.readouterr().out
+
+
+def test_flush_discipline_under_block_streaming_metrics(tmp_path, monkeypatch):
+    """Recorder flush discipline under the new record volume: a
+    block+streaming run with MetricPack enabled still flushes once per
+    block boundary (plus the documented fixed points: the post-meta
+    flush, run_end), performs NO per-record I/O, and the buffered size
+    stays bounded (nothing dropped)."""
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import Synthetic
+
+    flushes = []
+    real_flush = Recorder.flush
+
+    def counting_flush(self):
+        if self.path is not None:  # only the run's file-backed recorder
+            flushes.append(len(self._pending))
+        return real_flush(self)
+
+    monkeypatch.setattr(Recorder, "flush", counting_flush)
+    ds = Synthetic(num_clients=6, train_size=240, test_size=60, cache=False)
+    log = str(tmp_path / "out")
+    sim = Simulator(ds, log_path=log, seed=0, aggregator="median")
+    sim.run("mlp", global_rounds=4, local_steps=1, train_batch_size=8,
+            validate_interval=4, round_metrics=True, streaming=True,
+            client_chunks=3, block_size=2)
+    rec = sim.telemetry
+    assert rec.dropped == 0
+    # 4 rounds in 2 blocks: one flush after the meta record, one per
+    # block boundary, one at run_end (+ at most one from recorder swap)
+    assert len(flushes) <= 5
+    # per-round records batched per block: at least one flush carried a
+    # multi-round batch (metrics + round + span records for 2 rounds)
+    assert max(flushes) >= 4
+    # buffer stayed far below the bound (flushes actually drained it)
+    assert all(n < rec.max_buffer // 2 for n in flushes)
+    # and the trace really carries per-round metrics for all 4 rounds
+    recs = load_records(os.path.join(log, "telemetry.jsonl"))
+    assert [r["round"] for r in recs if r["t"] == "metrics"] == [1, 2, 3, 4]
+
+
+def test_heartbeat_margin_gauge_and_warning(tmp_path, monkeypatch):
+    """The heartbeat-margin satellite: beats gauge their interval, and a
+    beat landing within 25% of BLADES_HEARTBEAT_TIMEOUT emits a
+    schema-valid heartbeat_margin warning record."""
+    import time as _time
+
+    from blades_tpu.supervision import heartbeat as hb
+    from blades_tpu.telemetry.schema import load_schema, validate_record
+
+    rec = Recorder(enabled=True)
+    set_recorder(rec)
+    hb_file = str(tmp_path / "hb")
+    monkeypatch.setattr(hb, "_last_beat_ts", None)
+    monkeypatch.setenv(hb.HEARTBEAT_ENV, hb_file)
+    monkeypatch.setenv(hb.TIMEOUT_ENV, "0.02")
+    hb.beat(round_idx=1)
+    assert rec.gauges.get("heartbeat.interval_s") is None  # first beat: no gap
+    _time.sleep(0.03)  # eat >75% of the 20ms budget
+    hb.beat(round_idx=2)
+    assert rec.gauges["heartbeat.interval_s"] >= 0.02
+    assert rec.gauges["heartbeat.margin_s"] <= 0.0
+    margins = [r for r in rec.records if r["t"] == "heartbeat_margin"]
+    assert len(margins) == 1 and margins[0]["round"] == 2
+    assert validate_record(margins[0], load_schema()) == []
+    # the heartbeat FILE body carries the measured interval too
+    body = hb.read(hb_file)
+    assert body["round"] == 2 and body["interval_s"] >= 0.02
+    assert validate_record(body, load_schema()) == []
+
+    # far from the threshold: gauge updates, no warning record
+    monkeypatch.setenv(hb.TIMEOUT_ENV, "1000")
+    hb.beat(round_idx=3)
+    assert len([r for r in rec.records if r["t"] == "heartbeat_margin"]) == 1
+    # unsupervised (no timeout env): beats never warn
+    monkeypatch.delenv(hb.TIMEOUT_ENV)
+    hb.beat(round_idx=4)
+    assert len([r for r in rec.records if r["t"] == "heartbeat_margin"]) == 1
+
+
+def test_trace_summary_compare_cli(tmp_path, capsys):
+    """--compare A B: the two-terminal perf diff as one command — side by
+    side per-stage costs (per-round normalized) and counters."""
+    import trace_summary
+
+    def mk(path, wall, compiles):
+        rec = Recorder(enabled=True, path=path)
+        with rec.span("round"):
+            with rec.span("dispatch"):
+                pass
+        rec.counter("xla.compiles", compiles)
+        rec.round_record(1, wall_s=wall)
+        rec.close()
+
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    mk(a, 0.4, 5)
+    mk(b, 0.1, 3)
+    assert trace_summary.main(["--compare", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "round/dispatch" in out and "xla.compiles" in out
+    assert "B/A" in out
+    # wrong arity is a usage error, not a crash
+    assert trace_summary.main(["--compare", a]) == 2
+    assert trace_summary.main([a, b]) == 2
+    # machine-readable variant
+    assert trace_summary.main(["--compare", a, b, "--json"]) == 0
+    both = json.loads(capsys.readouterr().out)
+    assert both["a"]["rounds"]["count"] == 1
+    # summarize surfaces the new sections on a metrics-bearing trace
+    rec = Recorder(enabled=True, path=str(tmp_path / "m.jsonl"))
+    rec.event("metrics", round=1, cos_honest=0.9, cos_byz=0.1,
+              norm_median=0.5, masked_out=1)
+    rec.event("memory", program="round", flops=1e9, temp_bytes=123)
+    rec.round_record(1, wall_s=0.1)
+    rec.close()
+    s = trace_summary.summarize(
+        trace_summary.load_records(str(tmp_path / "m.jsonl"))
+    )
+    assert s["metrics"]["mean_cos_honest"] == pytest.approx(0.9)
+    assert s["programs"]["round"]["temp_bytes"] == 123
+    table = trace_summary.format_table(s)
+    assert "program[round]" in table and "metrics:" in table
+
+
 def test_trace_summary_normalizes_block_spans(tmp_path, capsys):
     """Round-block traces carry `block`-rooted spans covering several
     rounds each; the summary normalizes them to per-round averages (using
