@@ -1,0 +1,46 @@
+"""Static invariant linter + compiled-program auditor.
+
+Seven PRs of TPU-native rebuild accumulated load-bearing but *unenforced*
+invariants — donation/zero-copy-aliasing rules, the model-axis-reshard
+miscompile guard, XLA-flag probing before use, telemetry importable before
+jax, the Mosaic/Pallas proxy envelope, the one-JSON-line driver contracts —
+all living as prose in CLAUDE.md and CHANGES.md. This package turns each of
+them into a machine-checked gate:
+
+- **Tier A — AST lints** (stdlib ``ast``, no jax import anywhere on this
+  path): a small rule engine (:mod:`blades_tpu.analysis.core`, rules in
+  :mod:`blades_tpu.analysis.rules`). Each rule is a class with an id,
+  severity, and a rationale citing the incident that motivated it.
+  Violations are suppressed per line with ``# blades: allow[RULE001]``.
+- **Tier B — compiled-program auditor**
+  (:mod:`blades_tpu.analysis.program_audit`): lowers the real round /
+  round-block / streaming programs for a tiny MLP config and asserts
+  structural invariants on the jaxpr/HLO — donation actually honored,
+  no f64 ops, no model-axis sharding constraint on the ``[K, D]`` update
+  matrix, and jit-cache retrace stability (a second same-shape call adds
+  zero compiles to the telemetry counters).
+
+Entry point (one-JSON-line contract, like ``bench.py``)::
+
+    python -m blades_tpu.analysis --check            # Tier A + Tier B
+    python -m blades_tpu.analysis --check --tier a   # lints only (no jax)
+
+Rule table, incidents, and the suppression pragma: ``docs/static_analysis.md``.
+
+Import discipline: this module (and Tier A end to end) is stdlib-only so
+the lint can gate environments where jax cannot even initialize; only
+:mod:`~blades_tpu.analysis.program_audit` touches jax, lazily.
+
+Reference counterpart: none — the reference ships no analysis or CI tooling
+of any kind (SURVEY.md section 4: pure Python, no tests, no lint).
+"""
+
+from blades_tpu.analysis.core import (  # noqa: F401
+    RepoIndex,
+    Rule,
+    Violation,
+    run_rules,
+)
+from blades_tpu.analysis.rules import all_rules  # noqa: F401
+
+__all__ = ["RepoIndex", "Rule", "Violation", "run_rules", "all_rules"]

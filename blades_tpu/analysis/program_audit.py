@@ -1,0 +1,394 @@
+"""Tier B: structural invariants on the *compiled* round programs.
+
+The Tier-A lints catch source patterns; this auditor catches what only
+the lowered program can prove. It builds the real
+:class:`~blades_tpu.core.RoundEngine` round / round-block / streaming
+programs for a tiny MLP config (the ``dryrun_multichip`` recipe:
+production program shape, toy D) and asserts, per program:
+
+- **donation** — the state argument's donation is actually honored by the
+  backend: the compiled HLO carries an ``input_output_alias`` map (and
+  ``memory_analysis`` reports aliased bytes where the build exposes it).
+  This is the flip side of the PR 3 aliasing incident: donation is a
+  memory-correctness contract, and a jax upgrade silently dropping it
+  would both double round-state HBM and invalidate the
+  ``jnp.array(..., copy=True)`` restore discipline ALIAS001 lints for.
+- **dtype** — no ``f64`` ops anywhere in the program (x64 must stay
+  disabled; a stray float64 literal doubles bandwidth on TPU and
+  miscompiles on Mosaic).
+- **sharding axis** — no sharding constraint partitions the model axis of
+  any rank-2 ``[K, D]`` value: some XLA SPMD partitioner builds
+  miscompile the model-axis reshard of the update matrix (rows silently
+  become ``update + params``; CLAUDE.md, regression
+  ``tests/test_engine.py::test_sharded_2d_mesh_matches_unsharded``). The
+  engine constrains along clients only; this check walks every
+  ``sharding_constraint`` eqn in the jaxpr — including scan bodies — so
+  no future code path can reintroduce the trigger.
+- **retrace stability** — a second same-shape call adds ZERO compiles to
+  the telemetry compile counters and does not grow the jit cache: per-
+  round recompiles are the pathology that turns a 2-minute run into a
+  2-hour one on this box.
+
+Import discipline: jax is imported lazily inside functions — importing
+this module (docs/build.py api regen, the analysis CLI before ``--tier
+b`` is requested) stays jax-free, and the CLI can force the virtual-CPU
+platform before the first backend touch.
+
+Reference counterpart: none — the reference never inspects its own
+programs (SURVEY.md section 4; it has no compiler to audit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+#: Toy config: production program shape, seconds-scale compiles.
+_K, _STEPS, _BATCH = 8, 1, 2
+_BLOCK_ROUNDS = 2
+_CHUNKS = 2
+
+
+def _build_engine(plan=None, streaming: bool = False, client_chunks: int = 1):
+    """A tiny-MLP RoundEngine wired exactly like production (trimmed-mean
+    defense, sign-flip attack, donated state, matrix kept in-graph)."""
+    import jax
+
+    from blades_tpu.aggregators import get_aggregator
+    from blades_tpu.attackers import get_attack
+    from blades_tpu.core import ClientOptSpec, RoundEngine, ServerOptSpec
+    from blades_tpu.models.common import build_fns
+    from blades_tpu.models.mlp import MLP
+
+    spec = build_fns(MLP(num_classes=10, hidden=(8,)), sample_shape=(28, 28, 1))
+    params = spec.init(jax.random.PRNGKey(0))
+    engine = RoundEngine(
+        spec.train_loss_fn,
+        spec.eval_logits_fn,
+        params,
+        num_clients=_K,
+        num_byzantine=2,
+        attack=get_attack("signflipping"),
+        aggregator=get_aggregator("trimmedmean"),
+        client_opt=ClientOptSpec(),
+        server_opt=ServerOptSpec(),
+        num_classes=10,
+        plan=plan,
+        streaming=streaming,
+        client_chunks=client_chunks,
+        keep_updates=False,
+    )
+    return engine, params
+
+
+def _round_args(engine, params, plan=None):
+    import jax
+    import jax.numpy as jnp
+
+    state = engine.init(params)
+    kd = jax.random.PRNGKey(7)
+    cx = jax.random.normal(kd, (_K, _STEPS, _BATCH, 28, 28, 1), jnp.float32)
+    cy = jax.random.randint(
+        jax.random.fold_in(kd, 1), (_K, _STEPS, _BATCH), 0, 10
+    )
+    if plan is not None:
+        cx = jax.device_put(cx, plan.clients)
+        cy = jax.device_put(cy, plan.clients)
+    return state, cx, cy
+
+
+def _sampler() -> Callable:
+    """Traceable ``key -> (cx, cy)`` batch source for the block program
+    (the production sampler is likewise a pure function of the key)."""
+    import jax
+    import jax.numpy as jnp
+
+    def sampler(key):
+        cx = jax.random.normal(
+            key, (_K, _STEPS, _BATCH, 28, 28, 1), jnp.float32
+        )
+        cy = jax.random.randint(
+            jax.random.fold_in(key, 1), (_K, _STEPS, _BATCH), 0, 10
+        )
+        return cx, cy
+
+    return sampler
+
+
+def _result(check: str, program: str, ok: bool, detail: str) -> Dict[str, Any]:
+    return {"check": check, "program": program, "ok": bool(ok), "detail": detail}
+
+
+# -- individual invariants -----------------------------------------------------
+
+
+def check_donation(program: str, compiled) -> Dict[str, Any]:
+    """Donated state buffers must be aliased into outputs in the compiled
+    HLO (``input_output_alias``)."""
+    txt = compiled.as_text()
+    aliased = "input_output_alias" in txt
+    alias_bytes: Optional[int] = None
+    try:
+        ma = compiled.memory_analysis()
+        ma = ma[0] if isinstance(ma, (list, tuple)) and ma else ma
+        alias_bytes = int(getattr(ma, "alias_size_in_bytes", 0)) or None
+    except Exception:  # noqa: BLE001 - memory_analysis is optional per build
+        pass
+    detail = (
+        f"input_output_alias present, alias_bytes={alias_bytes}"
+        if aliased
+        else "compiled HLO has NO input_output_alias: state donation is "
+        "not honored (double round-state HBM; invalidates the "
+        "copy-on-restore discipline)"
+    )
+    return _result("donation", program, aliased, detail)
+
+
+def check_no_f64(program: str, compiled) -> Dict[str, Any]:
+    txt = compiled.as_text()
+    count = txt.count("f64[")
+    return _result(
+        "dtype_f64",
+        program,
+        count == 0,
+        "no f64 ops" if count == 0 else f"{count} f64-typed HLO values",
+    )
+
+
+def _walk_jaxpr(jaxpr, visit) -> None:
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for v in eqn.params.values():
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None:
+                _walk_jaxpr(inner, visit)
+            elif hasattr(v, "eqns"):
+                _walk_jaxpr(v, visit)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    inner = getattr(item, "jaxpr", None)
+                    if inner is not None:
+                        _walk_jaxpr(inner, visit)
+                    elif hasattr(item, "eqns"):
+                        _walk_jaxpr(item, visit)
+
+
+def check_sharding_axis(program: str, closed_jaxpr) -> Dict[str, Any]:
+    """No ``sharding_constraint`` may partition a non-client axis of a
+    rank-2 value (the ``[K, D]`` update matrix family)."""
+    bad: List[str] = []
+    n_constraints = [0]
+
+    def visit(eqn):
+        if eqn.primitive.name != "sharding_constraint":
+            return
+        n_constraints[0] += 1
+        sharding = eqn.params.get("sharding")
+        spec = getattr(sharding, "spec", None)
+        aval = eqn.outvars[0].aval
+        if spec is None or getattr(aval, "ndim", 0) != 2:
+            return
+        trailing = [s for s in tuple(spec)[1:] if s is not None]
+        if trailing:
+            bad.append(
+                f"rank-2 {tuple(aval.shape)} constrained with spec "
+                f"{tuple(spec)!r} (partitions axis>0)"
+            )
+
+    _walk_jaxpr(closed_jaxpr.jaxpr, visit)
+    return _result(
+        "sharding_axis",
+        program,
+        not bad,
+        "; ".join(bad)
+        if bad
+        else f"{n_constraints[0]} sharding constraints, all clients-axis "
+        "only on rank-2 values (model-axis reshard miscompile guard)",
+    )
+
+
+def check_retrace_stability(
+    program: str, run_twice: Callable[[], Any], jitfn=None
+) -> Dict[str, Any]:
+    """``run_twice()`` must execute the program twice with identical
+    shapes; the second execution must add zero backend compiles (pinned
+    via the telemetry compile counters, like tests/test_metric_pack.py)."""
+    from blades_tpu.telemetry import (
+        Recorder,
+        get_recorder,
+        install_jax_monitoring,
+        set_recorder,
+    )
+
+    install_jax_monitoring()
+    prev = get_recorder()
+    rec = Recorder(path=None, enabled=True)
+    set_recorder(rec)
+    try:
+        deltas = run_twice_with_counters(rec, run_twice)
+    finally:
+        set_recorder(prev if prev is not None else None)
+    second = deltas[-1]
+    cache_note = ""
+    if jitfn is not None:
+        cache_size = getattr(jitfn, "_cache_size", None)
+        if callable(cache_size):
+            cache_note = f", jit cache size {cache_size()}"
+            if cache_size() > 1:
+                return _result(
+                    "retrace_stability",
+                    program,
+                    False,
+                    f"jit cache grew to {cache_size()} entries for "
+                    "same-shape calls" + cache_note,
+                )
+    return _result(
+        "retrace_stability",
+        program,
+        second == 0,
+        f"compiles per call: {deltas} (second call must be 0)" + cache_note,
+    )
+
+
+def run_twice_with_counters(rec, run_twice) -> List[float]:
+    """Compile-counter delta per call of the 2-call sequence."""
+    deltas = []
+
+    def snap():
+        return rec.counters.get("xla.compiles", 0)
+
+    before = snap()
+    for out in run_twice():
+        now = snap()
+        deltas.append(now - before)
+        before = now
+    return deltas
+
+
+# -- the auditor ---------------------------------------------------------------
+
+
+def _mesh_plan():
+    """A (clients, model) plan over the available devices — model axis > 1
+    whenever the device count allows, to exercise the miscompile guard's
+    real trigger shape."""
+    import jax
+
+    from blades_tpu.parallel.mesh import make_mesh, make_plan
+
+    devices = jax.devices()
+    n = len(devices)
+    # a 1-wide clients axis (n == 2 → (1, 2)) still shards the model axis,
+    # which is the guard's real trigger; (n, 1) is the last resort only
+    shape = (n // 2, 2) if (n % 2 == 0 and n >= 2) else (n, 1)
+    return make_plan(make_mesh(devices[: shape[0] * shape[1]], shape)), shape
+
+
+def run_tier_b(force_platform: bool = False) -> Dict[str, Any]:
+    """Audit the round, round-block, and streaming programs; returns
+    ``{"checks": [...], "violations": N, "ok": bool, ...}``.
+
+    ``force_platform=True`` (the CLI path) forces the 8-device virtual
+    CPU platform before the first backend touch; under pytest the
+    conftest mesh is already up and the flag must stay False.
+    """
+    if force_platform:
+        from blades_tpu.utils.platform import force_virtual_cpu
+
+        force_virtual_cpu(8)
+
+    import jax
+    import jax.numpy as jnp
+
+    from blades_tpu.utils.xla_cache import enable_compilation_cache
+
+    enable_compilation_cache()
+    checks: List[Dict[str, Any]] = []
+    key = jax.random.PRNGKey(3)
+    lr = jnp.asarray(0.1, jnp.float32)
+
+    # -- round (dense, unsharded): donation + dtype + retrace ------------------
+    engine, params = _build_engine()
+    state, cx, cy = _round_args(engine, params)
+    compiled = engine._round_jit.lower(state, cx, cy, lr, lr, key).compile()
+    checks.append(check_donation("round", compiled))
+    checks.append(check_no_f64("round", compiled))
+
+    def round_twice():
+        st, cx2, cy2 = _round_args(engine, params)
+        st, _ = engine.run_round(st, cx2, cy2, 0.1, 1.0, key)
+        yield jax.block_until_ready(st.params)
+        st, _ = engine.run_round(st, cx2, cy2, 0.1, 1.0, key)
+        yield jax.block_until_ready(st.params)
+
+    checks.append(
+        check_retrace_stability("round", round_twice, engine._round_jit)
+    )
+
+    # -- round (dense, sharded 2-D mesh): the miscompile-guard axis check ------
+    plan, mesh_shape = _mesh_plan()
+    s_engine, s_params = _build_engine(plan=plan)
+    s_state, s_cx, s_cy = _round_args(s_engine, s_params, plan=plan)
+    closed = jax.make_jaxpr(s_engine._round)(s_state, s_cx, s_cy, lr, lr, key)
+    res = check_sharding_axis("round_sharded", closed)
+    res["detail"] += f" [mesh {mesh_shape}]"
+    checks.append(res)
+
+    # -- round-block: donation + dtype + retrace + axis ------------------------
+    b_engine, b_params = _build_engine()
+    sampler = _sampler()
+    block_jit = b_engine._build_block(sampler)
+    b_state, _, _ = _round_args(b_engine, b_params)
+    sample_keys = jax.random.split(jax.random.PRNGKey(11), _BLOCK_ROUNDS)
+    lrs = jnp.full((_BLOCK_ROUNDS,), 0.1, jnp.float32)
+    b_args = (b_state, sample_keys, lrs, lrs, key)
+    compiled = block_jit.lower(*b_args).compile()
+    checks.append(check_donation("block", compiled))
+    checks.append(check_no_f64("block", compiled))
+
+    def block_twice():
+        st, _, _ = _round_args(b_engine, b_params)
+        st, ys = block_jit(st, sample_keys, lrs, lrs, key)
+        yield jax.block_until_ready(st.params)
+        st, ys = block_jit(st, sample_keys, lrs, lrs, key)
+        yield jax.block_until_ready(st.params)
+
+    checks.append(check_retrace_stability("block", block_twice, block_jit))
+
+    # -- streaming round: donation + dtype + retrace + axis --------------------
+    st_engine, st_params = _build_engine(streaming=True, client_chunks=_CHUNKS)
+    st_state, st_cx, st_cy = _round_args(st_engine, st_params)
+    compiled = st_engine._round_jit.lower(
+        st_state, st_cx, st_cy, lr, lr, key
+    ).compile()
+    checks.append(check_donation("streaming", compiled))
+    checks.append(check_no_f64("streaming", compiled))
+    # axis check on the SHARDED streaming body (trace-only, no compile):
+    # the per-chunk [chunk, D] slab is rank-2 and carries the same
+    # clients-only constraint rule as the dense matrix
+    ss_engine, ss_params = _build_engine(
+        plan=plan, streaming=True, client_chunks=_CHUNKS
+    )
+    ss_state, ss_cx, ss_cy = _round_args(ss_engine, ss_params, plan=plan)
+    closed = jax.make_jaxpr(ss_engine._round)(ss_state, ss_cx, ss_cy, lr, lr, key)
+    res = check_sharding_axis("streaming_sharded", closed)
+    res["detail"] += f" [mesh {mesh_shape}]"
+    checks.append(res)
+
+    def streaming_twice():
+        st, cx2, cy2 = _round_args(st_engine, st_params)
+        st, _ = st_engine.run_round(st, cx2, cy2, 0.1, 1.0, key)
+        yield jax.block_until_ready(st.params)
+        st, _ = st_engine.run_round(st, cx2, cy2, 0.1, 1.0, key)
+        yield jax.block_until_ready(st.params)
+
+    checks.append(
+        check_retrace_stability("streaming", streaming_twice, st_engine._round_jit)
+    )
+
+    violations = [c for c in checks if not c["ok"]]
+    return {
+        "checks": checks,
+        "programs": sorted({c["program"] for c in checks}),
+        "violations": len(violations),
+        "ok": not violations,
+    }

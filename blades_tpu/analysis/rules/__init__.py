@@ -1,0 +1,49 @@
+"""Rule registry for the Tier-A lints.
+
+Each module in this package defines one or two :class:`~blades_tpu.
+analysis.core.Rule` subclasses; :func:`all_rules` instantiates the full
+set in a stable order. Adding a rule = adding a module here, registering
+it in ``_RULE_CLASSES``, seeding a fixture under
+``tests/fixtures/analysis/<ruleid>/`` and a row in
+``docs/static_analysis.md`` (the fixture test enforces the first, the
+docs test the table).
+
+Reference counterpart: none — the reference ships no lint of any kind
+(SURVEY.md section 4).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from blades_tpu.analysis.core import Rule
+from blades_tpu.analysis.rules.aliasing import Alias001
+from blades_tpu.analysis.rules.citations import Cite001
+from blades_tpu.analysis.rules.host_sync import Sync001
+from blades_tpu.analysis.rules.imports import Imp001, Imp002
+from blades_tpu.analysis.rules.json_contract import Json001
+from blades_tpu.analysis.rules.pallas import Pal001
+from blades_tpu.analysis.rules.schema_drift import Schema001
+from blades_tpu.analysis.rules.telemetry_io import Tel001
+from blades_tpu.analysis.rules.xla_flags import Xla001
+
+_RULE_CLASSES = (
+    Alias001,
+    Xla001,
+    Imp001,
+    Imp002,
+    Sync001,
+    Pal001,
+    Tel001,
+    Json001,
+    Cite001,
+    Schema001,
+)
+
+
+def all_rules() -> List[Rule]:
+    """Fresh instances of every registered rule, stable order."""
+    return [cls() for cls in _RULE_CLASSES]
+
+
+__all__ = ["all_rules"] + [cls.__name__ for cls in _RULE_CLASSES]

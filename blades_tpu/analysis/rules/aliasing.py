@@ -1,0 +1,140 @@
+"""ALIAS001: no zero-copy jnp construction on npz-load / restore paths.
+
+Incident (CHANGES.md PR 3): ``restore_state`` used ``jnp.asarray`` on npz
+members. On this CPU backend ``jnp.asarray`` ZERO-COPY aliases the
+numpy-owned buffer (alignment- and jaxlib-build-dependent), and the round
+program DONATES its state input — XLA then reused what it believed was its
+own buffer as output memory while numpy freed the real owner, so resumed
+rounds read heap garbage (flaky NaN/1e38 params; 0/6 bit-exact resumes
+before the fix, 6/6 after switching to ``jnp.array(..., copy=True)``).
+
+The rule: inside any function that calls ``np.load``/``numpy.load``, a
+value derived from the loaded archive must never be wrapped with
+``jnp.asarray(...)`` or ``jnp.array(...)`` without ``copy=True`` —
+device arrays built from an npz must be jax-owned.
+
+Reference counterpart: none — the reference has no checkpointing at all
+(SURVEY.md section 5), so it never had this bug to guard against.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from blades_tpu.analysis.core import (
+    ModuleSource,
+    RepoIndex,
+    Rule,
+    Violation,
+    dotted_name,
+)
+
+_LOADERS = {"np.load", "numpy.load", "onp.load"}
+_JNP_WRAPPERS = {"jnp.asarray", "jnp.array", "jax.numpy.asarray", "jax.numpy.array"}
+
+
+def _referenced_names(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class Alias001(Rule):
+    id = "ALIAS001"
+    severity = "error"
+    rationale = (
+        "PR 3 resumed-state corruption: jnp.asarray zero-copy aliased npz "
+        "buffers into a donated round-program input (CHANGES.md PR 3; "
+        "utils/checkpoint.py restore_state)."
+    )
+
+    def check(self, index: RepoIndex) -> List[Violation]:
+        # nested defs are walked both standalone and via their enclosing
+        # function (the enclosing walk is what carries closure taint into
+        # them), so identical findings are deduped rather than re-reported
+        out: List[Violation] = []
+        seen = set()
+        for mod in index.files:
+            if mod.tree is None:
+                continue
+            for fn in ast.walk(mod.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for v in self._check_function(mod, fn):
+                        key = (v.path, v.line, v.message)
+                        if key not in seen:
+                            seen.add(key)
+                            out.append(v)
+        return out
+
+    @staticmethod
+    def _bind(target: ast.AST, tainted: Set[str]) -> None:
+        if isinstance(target, ast.Name):
+            tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            tainted.update(
+                e.id for e in target.elts if isinstance(e, ast.Name)
+            )
+
+    def _check_function(self, mod: ModuleSource, fn: ast.AST) -> List[Violation]:
+        # pass 1: names bound to an npz archive, then (transitively, two
+        # sweeps) names bound to members/derivations of one. Bindings via
+        # plain/annotated assignment, walrus, and `with np.load(..) as z:`
+        # (the documented numpy idiom) all taint.
+        tainted: Set[str] = set()
+        for _ in range(3):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and node.targets:
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.NamedExpr):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        if item.optional_vars is None:
+                            continue
+                        v = item.context_expr
+                        if (
+                            isinstance(v, ast.Call)
+                            and dotted_name(v.func) in _LOADERS
+                        ) or (_referenced_names(v) & tainted):
+                            self._bind(item.optional_vars, tainted)
+                    continue
+                else:
+                    continue
+                is_load = (
+                    isinstance(value, ast.Call)
+                    and dotted_name(value.func) in _LOADERS
+                )
+                derives = bool(_referenced_names(value) & tainted)
+                if is_load or derives:
+                    for t in targets:
+                        self._bind(t, tainted)
+        if not tainted:
+            return []
+        out: List[Violation] = []
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            name = dotted_name(call.func)
+            if name not in _JNP_WRAPPERS or not call.args:
+                continue
+            if not (_referenced_names(call.args[0]) & tainted):
+                continue
+            copies = any(
+                kw.arg == "copy"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in call.keywords
+            )
+            if name.endswith(".asarray") or not copies:
+                out.append(
+                    self.violation(
+                        mod,
+                        call,
+                        f"{name}(...) on an npz-loaded value may zero-copy "
+                        "alias the numpy buffer into a donated program "
+                        "input (PR 3 resume corruption) — use "
+                        "jnp.array(..., copy=True)",
+                    )
+                )
+        return out

@@ -1,0 +1,329 @@
+"""SYNC001: no host-sync calls inside jit-reachable round-program code.
+
+Incident (CHANGES.md PR 1/PR 5 context; SURVEY.md §0): the whole design
+premise is that one federated round is ONE XLA program. A host sync —
+``.item()``, ``np.asarray`` on a traced value, ``time.time()`` inside a
+traced body, a Python ``if`` on a traced value — either breaks tracing
+outright (ConcretizationTypeError at the first attack/defense combination
+that reaches it) or silently forces a device→host round-trip per call,
+exactly the dispatch-bound regime PR 5 measured at 2.7× from scheduling
+alone. The reference's GeoMed did one ``.item()`` per client per Weiszfeld
+iteration (``aggregators/geomed.py`` docstring) — the anti-pattern this
+codebase exists to remove.
+
+Mechanics: within each module of the device-code surface
+(``core/engine.py``, ``ops/``, ``aggregators/``, ``faults/``, ``audit/``)
+the rule builds a module-local call graph. **Roots** are functions handed
+to ``jax.jit`` (call or decorator, incl. via ``functools.partial``), to
+``lax.scan``/``map``/``fori_loop``/``while_loop``/``cond``/``switch``,
+``jax.vmap``/``pmap``/``checkpoint``/``grad``/``value_and_grad``, or
+``pl.pallas_call`` — plus the cross-module dispatch protocol methods the
+engine traces by name (``aggregate*``, ``streaming_*``, ``on_updates``,
+``apply``, ``corrupt_chunk``, ``plan_streaming``). Reachability then
+propagates through same-module references (``self._helper``, bare names,
+nested defs). Banned inside reachable bodies:
+
+- ``.item()`` / ``.tolist()`` / ``.block_until_ready()`` / ``jax.device_get``
+- ``np.asarray`` / ``np.array`` (host materialization of a traced value)
+- ``time.time()`` / ``time.perf_counter()`` / ``time.monotonic()`` / ``time.sleep()``
+- ``float(...)``/``int(...)``/``bool(...)`` directly on a ``jnp.``/``jax.``/
+  ``lax.`` call result
+- a Python ``if``/``while`` whose test uses a local assigned from a
+  ``jnp.``/``jax.``/``lax.`` call (the traced-name heuristic; ``is``/``is
+  not`` comparisons are static and stay legal)
+
+Reference counterpart: the *negative* example — ``src/blades/aggregators/
+geomed.py``'s per-client ``.item()`` sync loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from blades_tpu.analysis.core import (
+    ModuleSource,
+    RepoIndex,
+    Rule,
+    Violation,
+    dotted_name,
+)
+
+#: Repo-relative prefixes/files forming the device-code surface.
+DEVICE_SCOPES = (
+    "blades_tpu/core",
+    "blades_tpu/ops",
+    "blades_tpu/aggregators",
+    "blades_tpu/faults",
+    "blades_tpu/audit",
+)
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_FN_CONSUMERS = {
+    "lax.scan", "jax.lax.scan",
+    "lax.map", "jax.lax.map",
+    "lax.fori_loop", "jax.lax.fori_loop",
+    "lax.while_loop", "jax.lax.while_loop",
+    "lax.cond", "jax.lax.cond",
+    "lax.switch", "jax.lax.switch",
+    "jax.vmap", "vmap",
+    "jax.pmap", "pmap",
+    "jax.checkpoint", "jax.remat",
+    "jax.grad", "jax.value_and_grad",
+    "pl.pallas_call", "pallas_call",
+    "shard_map", "jax.experimental.shard_map.shard_map",
+}
+#: Methods the engine dispatches into OTHER modules by name at trace time
+#: (``self.aggregator.aggregate(...)`` inside the jitted round body) — the
+#: cross-module edges a module-local call graph cannot see.
+PROTOCOL_ROOTS = {
+    "aggregate",
+    "aggregate_masked",
+    "_masked_aggregate",
+    "aggregate_with_diagnostics",
+    "aggregate_masked_with_diagnostics",
+    "diagnostics",
+    "streaming_init",
+    "streaming_update",
+    "streaming_finalize",
+    "streaming_apply",
+    "on_updates",
+    "apply",
+    "corrupt_chunk",
+    "plan_streaming",
+}
+
+_BANNED_CALLS = {
+    "time.time": "host clock read inside a traced body",
+    "time.perf_counter": "host clock read inside a traced body",
+    "time.monotonic": "host clock read inside a traced body",
+    "time.sleep": "host sleep inside a traced body",
+    "np.asarray": "numpy materialization of a traced value",
+    "np.array": "numpy materialization of a traced value",
+    "numpy.asarray": "numpy materialization of a traced value",
+    "numpy.array": "numpy materialization of a traced value",
+    "jax.device_get": "device->host transfer inside a traced body",
+}
+_BANNED_METHODS = {".item", ".tolist", ".block_until_ready"}
+_TRACED_ROOTS = {"jnp", "jax", "lax"}
+
+
+class _Fn:
+    __slots__ = ("node", "name", "reachable")
+
+    def __init__(self, node: ast.AST, name: str):
+        self.node = node
+        self.name = name
+        self.reachable = False
+
+
+def _own_statements(fn: ast.AST):
+    """Walk a function body, NOT descending into nested function/class
+    defs (those are separate graph nodes)."""
+    todo = list(fn.body)
+    while todo:
+        node = todo.pop(0)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        yield node
+        todo.extend(ast.iter_child_nodes(node))
+
+
+def _fn_refs(arg: ast.AST) -> Optional[str]:
+    """The def-name a function-valued argument refers to: ``f`` -> 'f',
+    ``self._round`` -> '_round', ``functools.partial(f, ...)`` -> 'f'."""
+    if isinstance(arg, ast.Call) and dotted_name(arg.func).endswith("partial"):
+        return _fn_refs(arg.args[0]) if arg.args else None
+    if isinstance(arg, ast.Attribute):
+        return arg.attr
+    if isinstance(arg, ast.Name):
+        return arg.id
+    return None
+
+
+class Sync001(Rule):
+    id = "SYNC001"
+    severity = "error"
+    rationale = (
+        "One round == one XLA program (SURVEY.md §0); host syncs inside "
+        "traced bodies re-create the reference's per-client .item() "
+        "dispatch floor PR 5 measured at 2.7x (CHANGES.md PR 5)."
+    )
+
+    def check(self, index: RepoIndex) -> List[Violation]:
+        out: List[Violation] = []
+        mods: List[ModuleSource] = []
+        for scope in DEVICE_SCOPES:
+            mods.extend(index.under(scope))
+            mods.extend(index.matching(scope + ".py"))
+        seen = set()
+        for mod in mods:
+            if mod.rel in seen or mod.tree is None:
+                continue
+            seen.add(mod.rel)
+            out.extend(self._check_module(mod))
+        return out
+
+    # -- per-module analysis ---------------------------------------------------
+
+    def _check_module(self, mod: ModuleSource) -> List[Violation]:
+        fns: List[_Fn] = []
+        by_name: Dict[str, List[_Fn]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = _Fn(node, node.name)
+                fns.append(fn)
+                by_name.setdefault(node.name, []).append(fn)
+
+        # roots: transform-referenced defs + protocol methods
+        root_names: Set[str] = set()
+        for call in ast.walk(mod.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            name = dotted_name(call.func)
+            if name in _JIT_NAMES or name in _FN_CONSUMERS:
+                # EVERY positional arg can be function-valued: lax.fori_loop
+                # takes its body at args[2], lax.cond its false branch at
+                # args[2], lax.switch a branch LIST at args[1] — and
+                # over-marking a non-function name is harmless (it only
+                # matches if a def by that name exists)
+                for arg in call.args:
+                    elems = (
+                        arg.elts
+                        if isinstance(arg, (ast.List, ast.Tuple))
+                        else (arg,)
+                    )
+                    for el in elems:
+                        ref = _fn_refs(el)
+                        if ref:
+                            root_names.add(ref)
+        for fn in fns:
+            decorators = getattr(fn.node, "decorator_list", [])
+            for dec in decorators:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                if dotted_name(d) in _JIT_NAMES or (
+                    isinstance(dec, ast.Call)
+                    and dotted_name(dec.func).endswith("partial")
+                    and dec.args
+                    and dotted_name(dec.args[0]) in _JIT_NAMES
+                ):
+                    root_names.add(fn.name)
+            if fn.name in PROTOCOL_ROOTS:
+                root_names.add(fn.name)
+
+        for fn in fns:
+            if fn.name in root_names:
+                fn.reachable = True
+
+        # propagate: any identifier referenced in a reachable body that
+        # names a same-module def marks that def reachable
+        changed = True
+        while changed:
+            changed = False
+            for fn in fns:
+                if not fn.reachable:
+                    continue
+                for node in _own_statements(fn.node):
+                    ref = None
+                    if isinstance(node, ast.Name):
+                        ref = node.id
+                    elif isinstance(node, ast.Attribute):
+                        ref = node.attr
+                    if ref and ref in by_name:
+                        for target in by_name[ref]:
+                            if not target.reachable:
+                                target.reachable = True
+                                changed = True
+
+        out: List[Violation] = []
+        for fn in fns:
+            if fn.reachable:
+                out.extend(self._check_body(mod, fn))
+        return out
+
+    def _check_body(self, mod: ModuleSource, fn: _Fn) -> List[Violation]:
+        out: List[Violation] = []
+        traced_locals: Set[str] = set()
+        for node in _own_statements(fn.node):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                root = dotted_name(node.value.func).split(".", 1)[0]
+                if root in _TRACED_ROOTS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            traced_locals.add(t.id)
+                        elif isinstance(t, (ast.Tuple, ast.List)):
+                            traced_locals.update(
+                                e.id for e in t.elts if isinstance(e, ast.Name)
+                            )
+        for node in _own_statements(fn.node):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                why = _BANNED_CALLS.get(name)
+                if why is not None:
+                    out.append(
+                        self.violation(
+                            mod,
+                            node,
+                            f"{name}() in jit-reachable `{fn.name}`: {why} "
+                            "(forces a device sync / breaks the "
+                            "one-round-one-program contract)",
+                        )
+                    )
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and "." + node.func.attr in _BANNED_METHODS
+                ):
+                    out.append(
+                        self.violation(
+                            mod,
+                            node,
+                            f"`.{node.func.attr}()` in jit-reachable "
+                            f"`{fn.name}`: blocking device->host sync",
+                        )
+                    )
+                    continue
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("float", "int", "bool")
+                    and node.args
+                    and isinstance(node.args[0], ast.Call)
+                    and dotted_name(node.args[0].func).split(".", 1)[0]
+                    in _TRACED_ROOTS
+                ):
+                    out.append(
+                        self.violation(
+                            mod,
+                            node,
+                            f"{node.func.id}(<{dotted_name(node.args[0].func)}"
+                            f"(...)>) in jit-reachable `{fn.name}`: "
+                            "concretizes a traced value "
+                            "(ConcretizationTypeError under jit)",
+                        )
+                    )
+            elif isinstance(node, (ast.If, ast.While)) and traced_locals:
+                if self._test_uses_traced(node.test, traced_locals):
+                    out.append(
+                        self.violation(
+                            mod,
+                            node,
+                            f"Python `{'if' if isinstance(node, ast.If) else 'while'}` "
+                            f"on a traced value in jit-reachable `{fn.name}` "
+                            "(assigned from a jnp/jax/lax call) — use "
+                            "jnp.where / lax.cond",
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _test_uses_traced(test: ast.AST, traced: Set[str]) -> bool:
+        # `x is None` / `x is not None` are static identity checks on the
+        # Python object, not value reads — legal under trace
+        if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        ):
+            return False
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name) and node.id in traced:
+                return True
+        return False

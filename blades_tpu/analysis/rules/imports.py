@@ -1,0 +1,226 @@
+"""IMP001 / IMP002: the pre-jax import contract of the telemetry package.
+
+Incident (CHANGES.md PR 7 / CLAUDE.md): the supervision stack embeds the
+telemetry recorder in stdlib-only subprocess tooling, and several entry
+points must decide platform flags BEFORE jax initializes a backend —
+so ``blades_tpu.telemetry`` (``__init__``/``recorder``/``schema``) and the
+``blades_tpu.supervision`` package are contracted to be importable with
+jax never entering ``sys.modules``. The jax-importing telemetry surfaces
+(``metric_pack``, ``profiling``) stay submodule-only imports for the same
+reason. The contract lived only as a CLAUDE.md sentence; one convenience
+re-export would silently break every pre-jax consumer.
+
+- **IMP001**: no module-scope ``import jax`` (or ``from jax ...``, or an
+  import of any known jax-importing blades module) in the contracted
+  files. Function-scope imports stay legal (lazy by construction).
+- **IMP002**: ``blades_tpu/telemetry/__init__.py`` must not import or
+  re-export ``metric_pack`` / ``profiling`` at module scope.
+
+The runtime counterpart (a subprocess asserting ``'jax' not in
+sys.modules`` after the import) lives in ``tests/test_analysis.py``.
+
+Reference counterpart: none — the reference has no import-order
+constraints (everything imports torch eagerly).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from blades_tpu.analysis.core import ModuleSource, RepoIndex, Rule, Violation
+
+#: Files contracted to import without pulling in jax (module scope).
+NO_JAX_SUFFIXES = (
+    "blades_tpu/telemetry/__init__.py",
+    "blades_tpu/telemetry/recorder.py",
+    "blades_tpu/telemetry/schema.py",
+    "blades_tpu/supervision/__init__.py",
+    "blades_tpu/supervision/__main__.py",
+    "blades_tpu/supervision/heartbeat.py",
+    "blades_tpu/supervision/supervisor.py",
+    "blades_tpu/analysis/__init__.py",
+    "blades_tpu/analysis/core.py",
+)
+
+#: blades modules known to import jax at module scope — importing one of
+#: these from a contracted file breaks the contract just as surely as
+#: ``import jax`` itself.
+JAX_IMPORTING_MODULES = (
+    "jax",
+    "jaxlib",
+    "flax",
+    "optax",
+    "blades_tpu.telemetry.metric_pack",
+    "blades_tpu.telemetry.profiling",
+    "blades_tpu.core",
+    "blades_tpu.simulator",
+    "blades_tpu.utils.platform",
+    "blades_tpu.analysis.program_audit",
+)
+
+
+def _package_of(rel: str) -> str:
+    """Dotted package containing a repo-relative file (``a/b/c.py`` and
+    ``a/b/__init__.py`` both → ``a.b``) — the base for resolving relative
+    imports."""
+    return rel.rsplit("/", 1)[0].replace("/", ".") if "/" in rel else ""
+
+
+def _resolve_relative(package: str, level: int, module) -> str:
+    """Absolute dotted name of a ``from .[module] import ...`` target, or
+    '' when the relative import escapes the known package."""
+    parts = package.split(".") if package else []
+    if level - 1 > len(parts):
+        return ""
+    if level > 1:
+        parts = parts[: len(parts) - (level - 1)]
+    return ".".join(parts + (module.split(".") if module else []))
+
+
+def _module_scope_imports(tree: ast.Module, package: str = ""):
+    """(node, module_name) for every import at module scope — including
+    inside module-level ``if``/``try`` blocks, which still execute at
+    import time — but NOT inside function/class-method bodies.
+
+    Relative imports resolve against ``package`` (``from . import
+    metric_pack`` in telemetry/ is the same contract breach as the
+    absolute spelling), and from-imports yield ``module.alias`` for each
+    name too: ``from blades_tpu.telemetry import metric_pack`` loads the
+    jax-importing submodule even though its module path alone looks
+    clean."""
+    todo = list(tree.body)
+    while todo:
+        node = todo.pop(0)
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield node, alias.name
+        elif isinstance(node, ast.ImportFrom):
+            base = (
+                _resolve_relative(package, node.level, node.module)
+                if node.level
+                else (node.module or "")
+            )
+            if base:
+                yield node, base
+                for alias in node.names:
+                    if alias.name != "*":
+                        yield node, f"{base}.{alias.name}"
+        elif isinstance(node, (ast.If, ast.Try, ast.With)):
+            for field in ("body", "orelse", "finalbody", "handlers"):
+                for child in getattr(node, field, []):
+                    if isinstance(child, ast.ExceptHandler):
+                        todo.extend(child.body)
+                    else:
+                        todo.append(child)
+
+
+def _is_or_under(name: str, root: str) -> bool:
+    return name == root or name.startswith(root + ".")
+
+
+class Imp001(Rule):
+    id = "IMP001"
+    severity = "error"
+    rationale = (
+        "Supervision/telemetry must import before jax (CLAUDE.md: keep "
+        "blades_tpu.telemetry importable before jax; CHANGES.md PR 3/PR 7)."
+    )
+
+    def check(self, index: RepoIndex) -> List[Violation]:
+        out: List[Violation] = []
+        for mod in index.matching(*NO_JAX_SUFFIXES):
+            if mod.tree is None:
+                continue
+            is_telemetry_init = mod.rel.endswith(Imp002._INIT_SUFFIX)
+            seen = set()  # one `from x import a, b` yields x, x.a, x.b —
+            # report each (line, offending root) once
+            for node, name in _module_scope_imports(
+                mod.tree, _package_of(mod.rel)
+            ):
+                bad = next(
+                    (r for r in JAX_IMPORTING_MODULES if _is_or_under(name, r)),
+                    None,
+                )
+                if bad is None:
+                    continue
+                if is_telemetry_init and _is_or_under(
+                    bad, "blades_tpu.telemetry"
+                ):
+                    # IMP002 owns the submodule-only discipline of the
+                    # telemetry __init__ — one rule per incident
+                    continue
+                key = (getattr(node, "lineno", 0), bad)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(
+                    self.violation(
+                        mod,
+                        node,
+                        f"module-scope import of {name!r} in a file "
+                        "contracted to be importable before jax "
+                        f"(pulls in {bad}); import it inside the "
+                        "function that needs it",
+                    )
+                )
+        return out
+
+
+class Imp002(Rule):
+    id = "IMP002"
+    severity = "error"
+    rationale = (
+        "metric_pack/profiling import jax; re-exporting them from "
+        "blades_tpu.telemetry would break every pre-jax consumer "
+        "(CHANGES.md PR 7 import discipline)."
+    )
+
+    _INIT_SUFFIX = "blades_tpu/telemetry/__init__.py"
+    _SUBMODULE_ONLY = ("metric_pack", "profiling")
+
+    def check(self, index: RepoIndex) -> List[Violation]:
+        out: List[Violation] = []
+        for mod in index.matching(self._INIT_SUFFIX):
+            if mod.tree is None:
+                continue
+            seen = set()
+
+            def add(node, leaf):
+                key = (getattr(node, "lineno", 0), leaf)
+                if key in seen:
+                    return
+                seen.add(key)
+                out.append(
+                    self.violation(
+                        mod,
+                        node,
+                        f"telemetry/__init__ imports jax-importing "
+                        f"submodule {leaf!r}; it must stay "
+                        "submodule-only (import blades_tpu.telemetry."
+                        f"{leaf} at the use site)",
+                    )
+                )
+
+            for node, name in _module_scope_imports(
+                mod.tree, _package_of(mod.rel)
+            ):
+                leaf = name.rsplit(".", 1)[-1]
+                if leaf in self._SUBMODULE_ONLY and "telemetry" in name:
+                    add(node, leaf)
+            # re-export at ANY scope (a function-level re-export is still
+            # __init__ API surface) — absolute or relative spelling
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.ImportFrom):
+                    continue
+                if node.level == 1 and node.module in self._SUBMODULE_ONLY:
+                    add(node, node.module)  # `from .metric_pack import f`
+                    continue
+                from_telemetry = (
+                    node.module and node.module.endswith("telemetry")
+                ) or (node.level == 1 and not node.module)
+                if from_telemetry:
+                    for alias in node.names:
+                        if alias.name in self._SUBMODULE_ONLY:
+                            add(node, alias.name)
+        return out

@@ -1,0 +1,105 @@
+"""JSON001: driver-facing scripts keep the one-JSON-line contract.
+
+Incident (CHANGES.md PR 2): the driver parses exactly one JSON line from
+each gate's stdout; a traceback-only death with empty stdout is
+indistinguishable from a hung tunnel, so ``bench.py`` grew a parent-level
+catch-all that converts ANY failure — including bugs in the ladder itself
+— into one parseable ``{"value": null, "error": ...}`` line. ``certify.py``
+and ``perf_report.py`` adopted the same discipline, and ``python -m
+blades_tpu.analysis`` must honor it too (it is itself a gate).
+
+The rule, over the registered contract scripts: the module must define a
+``main`` function whose body is wrapped in a top-level ``try`` with a
+catch-all handler (``except Exception`` or bare ``except``; an ``except
+SystemExit: raise`` sibling is the idiomatic argparse escape) that funnels
+to a ``print(json.dumps(...))`` call — so every failure path still emits
+the single final JSON line.
+
+Reference counterpart: none — the reference has no driver contract
+(its scripts die with tracebacks; SURVEY.md section 4).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from blades_tpu.analysis.core import RepoIndex, Rule, Violation, dotted_name
+
+#: Repo-relative suffixes of the scripts bound by the contract.
+CONTRACT_SCRIPTS = (
+    "bench.py",
+    "scripts/certify.py",
+    "scripts/perf_report.py",
+    "blades_tpu/analysis/__main__.py",
+)
+
+
+def _contains_json_print(node: ast.AST) -> bool:
+    for call in ast.walk(node):
+        if (
+            isinstance(call, ast.Call)
+            and dotted_name(call.func) == "print"
+            and call.args
+        ):
+            for arg in ast.walk(call.args[0]):
+                if (
+                    isinstance(arg, ast.Call)
+                    and dotted_name(arg.func) == "json.dumps"
+                ):
+                    return True
+    return False
+
+
+class Json001(Rule):
+    id = "JSON001"
+    severity = "error"
+    rationale = (
+        "The driver parses exactly one JSON line per gate; an unhandled "
+        "exception means empty stdout, indistinguishable from a hung "
+        "tunnel (CHANGES.md PR 2, bench.py parent contract)."
+    )
+
+    def check(self, index: RepoIndex) -> List[Violation]:
+        out: List[Violation] = []
+        for mod in index.matching(*CONTRACT_SCRIPTS):
+            if mod.tree is None:
+                continue
+            mains = [
+                n
+                for n in mod.tree.body
+                if isinstance(n, ast.FunctionDef) and n.name == "main"
+            ]
+            if not mains:
+                out.append(
+                    self.violation(
+                        mod,
+                        1,
+                        "contract script has no top-level `main()` to carry "
+                        "the one-JSON-line catch-all",
+                    )
+                )
+                continue
+            main = mains[0]
+            ok = False
+            for stmt in main.body:
+                if not isinstance(stmt, ast.Try):
+                    continue
+                for handler in stmt.handlers:
+                    is_catch_all = handler.type is None or dotted_name(
+                        handler.type
+                    ) in ("Exception", "BaseException")
+                    if is_catch_all and _contains_json_print(handler):
+                        ok = True
+            if not ok:
+                out.append(
+                    self.violation(
+                        mod,
+                        main,
+                        "main() lacks a top-level try/except-Exception "
+                        "funneling to print(json.dumps(...)): a failure "
+                        "here reaches the driver as empty stdout instead "
+                        "of one parseable error line",
+                    )
+                )
+        return out

@@ -1,0 +1,157 @@
+"""PAL001: Pallas kernels stay inside the Mosaic proxy's envelope.
+
+Incident (CHANGES.md PR 1-5 era / CLAUDE.md): this box's TPU attachment
+mode proxies compiles through a remote helper that **500s on some Mosaic
+programs** — in-kernel ``fori_loop`` and multi-block grids with lane
+width < 1024 are rejected, and the HTTP 500 hides the real error. A
+kernel that cannot compile inside the jitted round program fails the
+WHOLE round compile, so ``ops/pallas_trimmed.py`` (a) unrolls its
+extraction loop in Python instead of ``fori_loop`` and (b) AOT-probes the
+exact kernel (``_pallas_ok``) before dispatching to it, falling back to
+plain XLA.
+
+The rule, over ``blades_tpu/ops/``:
+
+- no ``lax.fori_loop`` / ``lax.while_loop`` / ``lax.scan`` inside a
+  kernel body (a function passed to ``pl.pallas_call`` or whose first
+  parameter ends in ``_ref``), transitively through same-module helpers;
+- any module that calls ``pl.pallas_call`` must define an AOT compile
+  probe (a function named ``_pallas_ok`` or ``*_pallas_ok``) AND call it
+  on some dispatch path — kernels without a probed fallback poison the
+  round compile on proxied backends.
+
+Lane width < 1024 is shape-dependent and stays enforced dynamically by
+the probe itself; the static rule pins the probe's existence and use.
+
+Reference counterpart: none — the reference has no device kernels
+(``src/blades/aggregators/trimmedmean.py:29-44`` is host-side topk).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from blades_tpu.analysis.core import (
+    ModuleSource,
+    RepoIndex,
+    Rule,
+    Violation,
+    dotted_name,
+)
+
+_PALLAS_CALL = {"pl.pallas_call", "pallas_call", "pallas.pallas_call"}
+_LOOPS = {
+    "lax.fori_loop", "jax.lax.fori_loop",
+    "lax.while_loop", "jax.lax.while_loop",
+    "lax.scan", "jax.lax.scan",
+}
+
+
+class Pal001(Rule):
+    id = "PAL001"
+    severity = "error"
+    rationale = (
+        "The Mosaic compile proxy 500s on in-kernel fori_loop and narrow "
+        "multi-block grids; an unprobed kernel fails the whole round "
+        "compile (CLAUDE.md 'Environment quirks'; ops/pallas_trimmed.py)."
+    )
+
+    def check(self, index: RepoIndex) -> List[Violation]:
+        out: List[Violation] = []
+        for mod in index.under("blades_tpu/ops"):
+            if mod.tree is None:
+                continue
+            out.extend(self._check_module(mod))
+        return out
+
+    def _check_module(self, mod: ModuleSource) -> List[Violation]:
+        fns = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(node.name, node)
+
+        pallas_call_sites = []
+        kernel_names: Set[str] = set()
+        for call in ast.walk(mod.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if dotted_name(call.func) in _PALLAS_CALL:
+                pallas_call_sites.append(call)
+                if call.args:
+                    arg = call.args[0]
+                    if (
+                        isinstance(arg, ast.Call)
+                        and dotted_name(arg.func).endswith("partial")
+                        and arg.args
+                    ):
+                        arg = arg.args[0]
+                    name = dotted_name(arg).rsplit(".", 1)[-1]
+                    if name:
+                        kernel_names.add(name)
+        for name, node in fns.items():
+            args = node.args.posonlyargs + node.args.args
+            if args and args[0].arg.endswith("_ref"):
+                kernel_names.add(name)
+
+        if not pallas_call_sites and not kernel_names:
+            return []
+
+        out: List[Violation] = []
+
+        # (a) no loop constructs inside kernels, transitively through
+        # same-module helpers referenced from a kernel body
+        reachable: Set[str] = set()
+        todo = [n for n in kernel_names if n in fns]
+        while todo:
+            name = todo.pop()
+            if name in reachable:
+                continue
+            reachable.add(name)
+            for node in ast.walk(fns[name]):
+                ref = None
+                if isinstance(node, ast.Name):
+                    ref = node.id
+                elif isinstance(node, ast.Attribute):
+                    ref = node.attr
+                if ref and ref in fns and ref not in reachable:
+                    todo.append(ref)
+        for name in sorted(reachable):
+            for call in ast.walk(fns[name]):
+                if (
+                    isinstance(call, ast.Call)
+                    and dotted_name(call.func) in _LOOPS
+                ):
+                    out.append(
+                        self.violation(
+                            mod,
+                            call,
+                            f"{dotted_name(call.func)} inside Pallas kernel "
+                            f"path `{name}`: the Mosaic compile proxy "
+                            "rejects in-kernel loop constructs (HTTP 500 "
+                            "hides the error) — unroll in Python "
+                            "(ops/pallas_trimmed.py:_trim_survivor_mean)",
+                        )
+                    )
+
+        # (b) pallas_call modules must define AND call an AOT probe
+        if pallas_call_sites:
+            probe_defs = [n for n in fns if n.endswith("_pallas_ok")]
+            probe_called = any(
+                isinstance(c, ast.Call)
+                and dotted_name(c.func).rsplit(".", 1)[-1].endswith("_pallas_ok")
+                for c in ast.walk(mod.tree)
+            )
+            if not probe_defs or not probe_called:
+                out.append(
+                    self.violation(
+                        mod,
+                        pallas_call_sites[0],
+                        "pl.pallas_call without an AOT compile probe "
+                        "(`_pallas_ok`-style lower+compile of the exact "
+                        "kernel, with a plain-XLA fallback): an unprobed "
+                        "kernel fails the whole round compile on proxied "
+                        "Mosaic backends",
+                    )
+                )
+        return out

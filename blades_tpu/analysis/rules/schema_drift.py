@@ -1,0 +1,108 @@
+"""SCHEMA001: every statically-emitted telemetry record type is declared
+in the committed schema.
+
+Incident (CHANGES.md PR 7): record types used to live only in prose — a
+field renamed in code drifted silently until some consumer
+(trace_summary, chaos invariants, perf_report) mis-parsed a trace weeks
+later. PR 7 added the machine-readable ``docs/telemetry_schema.json``
+plus a *dynamic* tier-1 test validating a real run's trace. The dynamic
+test only sees record types that particular run emits; this rule closes
+the gap statically: it scans ``blades_tpu/`` for every literal record
+type — ``rec.event("<type>", ...)`` first arguments and ``{"t": "<type>",
+...}`` dict literals — and fails when one is missing from the schema, so
+a brand-new record type cannot land without declaring itself (and
+therefore the docs) even if no test exercises it.
+
+Reference counterpart: none — the reference's flat ``stats`` file has no
+schema to drift from (``src/blades/utils.py:67-95``).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from typing import Dict, List, Tuple
+
+from blades_tpu.analysis.core import RepoIndex, Rule, Violation
+
+SCHEMA_REL = "docs/telemetry_schema.json"
+
+
+def emitted_types(index: RepoIndex) -> List[Tuple[str, str, int]]:
+    """(type, rel_path, line) for every statically-visible record emit in
+    ``blades_tpu/``."""
+    out: List[Tuple[str, str, int]] = []
+    for mod in index.under("blades_tpu"):
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "event"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                out.append((node.args[0].value, mod.rel, node.lineno))
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if (
+                        isinstance(k, ast.Constant)
+                        and k.value == "t"
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                    ):
+                        out.append((v.value, mod.rel, node.lineno))
+    return out
+
+
+class Schema001(Rule):
+    id = "SCHEMA001"
+    severity = "error"
+    rationale = (
+        "Telemetry record types drifted silently before the committed "
+        "schema existed; the dynamic validator only covers types a test "
+        "run happens to emit (CHANGES.md PR 7)."
+    )
+
+    def check(self, index: RepoIndex) -> List[Violation]:
+        raw = index.text(SCHEMA_REL)
+        emits = emitted_types(index)
+        if raw is None:
+            if not emits:
+                return []  # tree without telemetry surface (fixtures)
+            return [
+                Violation(
+                    rule=self.id,
+                    path=SCHEMA_REL,
+                    line=0,
+                    message="telemetry record emits exist but the schema "
+                    "file is missing",
+                )
+            ]
+        try:
+            declared: Dict = json.loads(raw).get("types", {})
+        except (json.JSONDecodeError, AttributeError) as e:
+            return [
+                Violation(
+                    rule=self.id,
+                    path=SCHEMA_REL,
+                    line=0,
+                    message=f"schema file does not parse: {e}",
+                )
+            ]
+        out: List[Violation] = []
+        for t, rel, line in emits:
+            if t not in declared:
+                out.append(
+                    Violation(
+                        rule=self.id,
+                        path=rel,
+                        line=line,
+                        message=f"record type {t!r} is emitted here but not "
+                        f"declared in {SCHEMA_REL} — declare it (and "
+                        "document it in docs/observability.md)",
+                    )
+                )
+        return out

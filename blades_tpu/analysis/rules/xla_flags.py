@@ -1,0 +1,108 @@
+"""XLA001: raw XLA flag strings live only in ``utils/platform.py``.
+
+Incident (CHANGES.md PR 1 / CLAUDE.md): XLA **F-aborts the whole process
+on unknown entries in ``XLA_FLAGS``** (``parse_flags_from_env.cc``), and
+jaxlib builds drift between containers — the old unconditional
+``--xla_cpu_collective_call_terminate_timeout_seconds`` aborted every test
+run at collection on builds that didn't register it. The fix made
+``utils/platform.py`` the single owner of the recipe: it probes the
+``xla_extension`` binary for each flag (``_xla_supports_flag``) before
+ever passing it, and every launcher builds its environment from those
+helpers.
+
+The rule: outside ``blades_tpu/utils/platform.py``, no string literal may
+carry a raw ``--xla_...`` flag, and ``os.environ["XLA_FLAGS"]`` may not be
+assigned a literal — route through ``virtual_cpu_flags`` /
+``virtual_cpu_env`` / ``force_virtual_cpu`` so the probe stays in the
+loop. (Deleting/forwarding the env var is fine; only introducing raw flag
+text is flagged.)
+
+Reference counterpart: none — the reference has no accelerator-platform
+plumbing at all (Ray schedules CPU/GPU actors).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from blades_tpu.analysis.core import RepoIndex, Rule, Violation, dotted_name
+
+_OWNER_SUFFIX = "blades_tpu/utils/platform.py"
+_RAW_FLAG_RE = re.compile(r"--xla_\w+")
+
+
+class Xla001(Rule):
+    id = "XLA001"
+    severity = "error"
+    rationale = (
+        "Unknown XLA_FLAGS entries F-abort the process; jaxlib builds "
+        "drift, so flags must pass utils/platform.py's binary probe "
+        "(CHANGES.md PR 1, CLAUDE.md 'Environment quirks')."
+    )
+
+    @staticmethod
+    def _docstring_nodes(tree: ast.AST) -> set:
+        """ids of docstring Constants (prose may legitimately *name* a
+        flag; only executable string literals carry one into XLA_FLAGS)."""
+        out = set()
+        for node in ast.walk(tree):
+            if isinstance(
+                node,
+                (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef),
+            ):
+                body = getattr(node, "body", [])
+                if (
+                    body
+                    and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)
+                ):
+                    out.add(id(body[0].value))
+        return out
+
+    def check(self, index: RepoIndex) -> List[Violation]:
+        out: List[Violation] = []
+        for mod in index.files:
+            if mod.tree is None or mod.rel.endswith(_OWNER_SUFFIX):
+                continue
+            docstrings = self._docstring_nodes(mod.tree)
+            for node in ast.walk(mod.tree):
+                if (
+                    isinstance(node, ast.Constant)
+                    and id(node) not in docstrings
+                    and isinstance(node.value, str)
+                    and _RAW_FLAG_RE.search(node.value)
+                ):
+                    flag = _RAW_FLAG_RE.search(node.value).group(0)
+                    out.append(
+                        self.violation(
+                            mod,
+                            node,
+                            f"raw XLA flag string {flag!r} outside "
+                            "utils/platform.py — unknown flags F-abort the "
+                            "process on some jaxlib builds; build the value "
+                            "via platform.virtual_cpu_flags()/virtual_cpu_env()",
+                        )
+                    )
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Subscript)
+                            and dotted_name(t.value) == "os.environ"
+                            and isinstance(t.slice, ast.Constant)
+                            and t.slice.value == "XLA_FLAGS"
+                            and isinstance(node.value, ast.Constant)
+                        ):
+                            out.append(
+                                self.violation(
+                                    mod,
+                                    node,
+                                    "literal assignment to os.environ"
+                                    "['XLA_FLAGS'] outside utils/platform.py "
+                                    "— use platform.force_virtual_cpu()/"
+                                    "virtual_cpu_env()",
+                                )
+                            )
+        return out

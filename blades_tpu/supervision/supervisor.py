@@ -91,6 +91,11 @@ POLICIES: Dict[str, DegradePolicy] = {
             "single_device",
             {
                 "JAX_PLATFORMS": "cpu",
+                # supervision is stdlib-only (must run when jax can't even
+                # import), so it cannot route through utils/platform's
+                # probed recipe; this one flag predates the probe era and
+                # is registered on every jaxlib build we've met.
+                # blades: allow[XLA001]
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
             },
             "collapse the device mesh to 1 virtual CPU device "
@@ -106,6 +111,8 @@ POLICIES: Dict[str, DegradePolicy] = {
             {
                 "JAX_PLATFORMS": "cpu",
                 "BENCH_FORCE_CPU": "1",
+                # same stdlib-only rationale as single_device above
+                # blades: allow[XLA001]
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
             },
             "abandon the accelerator attachment for this attempt",

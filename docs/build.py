@@ -80,6 +80,9 @@ GALLERY = [
 
 API_MODULES = [
     "blades_tpu",
+    "blades_tpu.analysis",
+    "blades_tpu.analysis.core",
+    "blades_tpu.analysis.program_audit",
     "blades_tpu.telemetry",
     "blades_tpu.telemetry.metric_pack",
     "blades_tpu.telemetry.profiling",

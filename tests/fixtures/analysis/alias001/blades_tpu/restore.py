@@ -1,0 +1,8 @@
+"""Fixture: ALIAS001. Reference counterpart: none — lint fixture."""
+import numpy as np
+import jax.numpy as jnp
+
+
+def restore(path, n):
+    z = np.load(path)
+    return [jnp.asarray(z[f"leaf_{i}"]) for i in range(n)]  # VIOLATION

@@ -1,0 +1,8 @@
+"""Fixture: IMP001. Reference counterpart: none — lint fixture."""
+import json
+import jax  # VIOLATION: module-scope jax in a pre-jax-contracted file
+
+
+class Recorder:
+    def snapshot(self):
+        return json.dumps({"backend": jax.default_backend()})

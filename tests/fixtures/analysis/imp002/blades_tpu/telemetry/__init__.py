@@ -1,0 +1,2 @@
+"""Fixture: IMP002. Reference counterpart: none — lint fixture."""
+from blades_tpu.telemetry import metric_pack  # VIOLATION: submodule-only

@@ -1,0 +1,11 @@
+"""Fixture: JSON001 — a gate script whose main() lacks the catch-all."""
+import json
+
+
+def main():
+    # VIOLATION: no top-level try/except funneling failures to one line
+    print(json.dumps({"metric": "fixture", "value": 1}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Fixture: PAL001. Reference counterpart: none — lint fixture."""
+import functools
+
+import jax
+from jax import lax
+from jax.experimental import pallas as pl
+
+
+def _kernel(u_ref, o_ref, *, k):
+    def body(i, acc):
+        return acc + u_ref[i, :]
+
+    # VIOLATION: in-kernel loop construct (Mosaic proxy rejects it)
+    o_ref[...] = lax.fori_loop(0, k, body, u_ref[0, :] * 0.0)
+
+
+def _fixture_pallas_ok(k, d):
+    try:
+        _run.lower(jax.ShapeDtypeStruct((k, d), "float32")).compile()
+        return True
+    except Exception:
+        return False
+
+
+@jax.jit
+def _run(u):
+    return pl.pallas_call(functools.partial(_kernel, k=4), grid=(1,))(u)
+
+
+def column_sum(u):
+    if _fixture_pallas_ok(*u.shape):
+        return _run(u)
+    return u.sum(axis=0)

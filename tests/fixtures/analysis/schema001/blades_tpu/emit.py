@@ -1,0 +1,6 @@
+"""Fixture: SCHEMA001. Reference counterpart: none — lint fixture."""
+from blades_tpu.telemetry import get_recorder
+
+
+def log_surprise():
+    get_recorder().event("fixture_undeclared_type", x=1)  # VIOLATION

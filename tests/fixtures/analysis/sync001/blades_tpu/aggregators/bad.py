@@ -1,0 +1,8 @@
+"""Fixture: SYNC001. Reference counterpart: none — lint fixture."""
+import jax.numpy as jnp
+
+
+def aggregate(updates, state=(), **ctx):
+    norm = jnp.linalg.norm(updates, axis=1)
+    worst = norm.max().item()  # VIOLATION: host sync in a traced body
+    return updates.mean(axis=0) / worst, state

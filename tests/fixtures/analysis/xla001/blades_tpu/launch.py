@@ -1,0 +1,3 @@
+"""Fixture: XLA001. Reference counterpart: none — lint fixture."""
+
+CHILD_ENV = {"XLA_FLAGS": "--xla_fixture_unprobed_flag=1"}  # VIOLATION
