@@ -110,6 +110,8 @@ def _make_agg(get_aggregator, agg_name: str, num_byz: int, explicit: bool):
 
 
 def child_main() -> None:
+    from blades_tpu.telemetry import context as _run_context
+
     k = int(os.environ.get("BENCH_CLIENTS", 1000))
     local_steps = int(os.environ.get("BENCH_LOCAL_STEPS", 1))
     batch = int(os.environ.get("BENCH_BATCH", 32))
@@ -494,6 +496,13 @@ def child_main() -> None:
                     "telemetry": telemetry,
                     "platform": devices[0].platform,
                     "n_devices": len(devices),
+                    # run identity (telemetry/context.py): inherited from
+                    # the parent ladder / capture harness via env, so every
+                    # child row is attributable to its run (context owns
+                    # the guarded attempt parse — a malformed value must
+                    # not break the one-JSON-line child contract)
+                    "run_id": os.environ.get("BLADES_RUN_ID"),
+                    "attempt": _run_context._attempt_from_env(),
                 }
             ),
             flush=True,
@@ -545,6 +554,27 @@ def _ladder_main() -> None:
     smoke_k = int(os.environ.get("BENCH_SMOKE_CLIENTS", 100))
     smoke_timeout = float(os.environ.get("BENCH_SMOKE_TIMEOUT", 600))
     chunks = os.environ.get("BENCH_CHUNKS", 4)
+
+    # run identity + provenance ledger (stdlib-only telemetry modules):
+    # mint here so every subprocess child inherits the id via env, and the
+    # whole ladder lands in results/ledger.jsonl as one addressable run
+    from blades_tpu.telemetry import context as _context
+    from blades_tpu.telemetry import ledger as _ledger
+
+    ctx = _context.activate(fresh=True)
+    bench_config = {
+        "kind": "bench",
+        "metric": METRIC,
+        "clients": full_k,
+        "chunks": str(chunks),
+        "model": os.environ.get("BENCH_MODEL", "cct_2_3x2_32"),
+        "agg": os.environ.get("BENCH_AGG", "trimmedmean"),
+        "attack": os.environ.get("BENCH_ATTACK", "") or None,
+        "block": os.environ.get("BENCH_BLOCK", "1"),
+        "streaming": os.environ.get("BENCH_STREAMING", "0"),
+        "bf16": os.environ.get("BENCH_BF16", "1"),
+    }
+    ledger_entry = _ledger.run_started("bench", config=bench_config)
 
     errors = []
     # liveness probe first: when the TPU tunnel is down, backend init hangs
@@ -652,6 +682,12 @@ def _ladder_main() -> None:
         prior = prior_tpu_capture()
         if prior is not None:
             payload["prior_tpu_capture"] = prior
+        payload["run_id"] = ctx.run_id
+        # the ladder produced no measurement — that is a crashed run in
+        # the ledger's outcome vocabulary, not a finished one
+        ledger_entry.ended(
+            "crashed", metrics={"value": None}, error=payload["error"]
+        )
         print(json.dumps(payload))
         sys.exit(1)
 
@@ -745,6 +781,15 @@ def _ladder_main() -> None:
         prior = prior_tpu_capture()
         if prior is not None:
             payload["prior_tpu_capture"] = prior
+    payload["run_id"] = ctx.run_id
+    ledger_entry.ended(
+        "finished",
+        metrics={
+            "value": payload["value"],
+            "rounds_per_sec": payload["value"],
+            **({"config": payload["config"]} if "config" in payload else {}),
+        },
+    )
     print(json.dumps(payload))
 
 

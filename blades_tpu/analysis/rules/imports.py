@@ -35,6 +35,9 @@ NO_JAX_SUFFIXES = (
     "blades_tpu/telemetry/__init__.py",
     "blades_tpu/telemetry/recorder.py",
     "blades_tpu/telemetry/schema.py",
+    "blades_tpu/telemetry/context.py",
+    "blades_tpu/telemetry/ledger.py",
+    "blades_tpu/telemetry/alerts.py",
     "blades_tpu/supervision/__init__.py",
     "blades_tpu/supervision/__main__.py",
     "blades_tpu/supervision/heartbeat.py",

@@ -31,6 +31,7 @@ CONTRACT_SCRIPTS = (
     "bench.py",
     "scripts/certify.py",
     "scripts/perf_report.py",
+    "scripts/runs.py",
     "blades_tpu/analysis/__main__.py",
 )
 

@@ -42,6 +42,9 @@ from blades_tpu.parallel.mesh import auto_mesh_shape, make_mesh, make_plan
 from blades_tpu.server import BladesServer
 from blades_tpu.supervision import heartbeat as _heartbeat
 from blades_tpu.telemetry import Recorder, install_jax_monitoring, set_recorder
+from blades_tpu.telemetry import alerts as _alerts
+from blades_tpu.telemetry import context as _context
+from blades_tpu.telemetry import ledger as _ledger
 from blades_tpu.telemetry import profiling as _profiling
 from blades_tpu.telemetry.metric_pack import pack_to_fields
 from blades_tpu.utils.checkpoint import checkpoint_file, restore_state, save_state
@@ -451,6 +454,33 @@ class Simulator:
                 "streaming=True never materializes the [K, D] update matrix "
                 "that retain_updates/on_round_end read; run dense for those"
             )
+        # run identity (telemetry/context.py): a fresh top-level run mints
+        # a new run_id (and exports it, so subprocesses correlate); a
+        # supervised relaunch inherits the supervisor's id + attempt — all
+        # attempts of one supervised run stitch under one id
+        _context.activate(fresh=True)
+        # canonical config -> stable fingerprint: "same experiment,
+        # different run" becomes a string equality in the ledger/trace
+        # (trace_summary --compare refuses to silently diff unrelated runs)
+        run_config = {
+            "kind": "simulator",
+            "num_clients": self.dataset.num_clients,
+            "num_byzantine": self.num_byzantine,
+            "attack": repr(self.attack),
+            "aggregator": repr(self.aggregator),
+            "seed": self.seed,
+            "model": model if isinstance(model, str) else type(model).__name__,
+            "global_rounds": global_rounds,
+            "local_steps": local_steps,
+            "train_batch_size": train_batch_size or self._train_bs,
+            "client_lr": client_lr,
+            "server_lr": server_lr,
+            "client_chunks": client_chunks,
+            "block_size": block_size,
+            "streaming": streaming,
+            **({"fault_model": repr(fault_model)} if fault_model else {}),
+        }
+        config_fp = _ledger.config_fingerprint(run_config)
         trace_path = os.path.join(self.log_path, "telemetry.jsonl")
         # the log-dir wipe preserves the trace for kill -> relaunch
         # post-mortems, but a FRESH unsupervised run is a NEW experiment:
@@ -467,6 +497,7 @@ class Simulator:
             path=trace_path,
             meta={
                 "run": "simulator",
+                "config_fingerprint": config_fp,
                 "num_clients": self.dataset.num_clients,
                 "num_byzantine": self.num_byzantine,
                 "attack": repr(self.attack),
@@ -488,159 +519,183 @@ class Simulator:
         self.telemetry = rec
         set_recorder(rec)  # engine spans + jax compile events land here
         install_jax_monitoring()
+        # anomaly alerting (telemetry/alerts.py): rule evaluation rides the
+        # records the run already emits at the existing flush cadence; a
+        # critical alert (non-finite/diverging loss) can recycle a
+        # supervised run via BLADES_ALERT_FILE. No-op when telemetry is off.
+        self.alert_engine = _alerts.install(rec)
         # create the trace file (meta record) NOW: a run killed mid-compile
         # — the documented tunnel-hang scenario — must still leave a trace
         # to post-mortem, not depend on surviving to the first round flush
         rec.flush()
-        spec = self._model_spec(model, loss, compute_dtype)
-        batch_size = train_batch_size or self._train_bs
-
-        key = jax.random.PRNGKey(self.seed)
-        params = spec.init(jax.random.fold_in(key, 17))
-
-        trusted = jnp.asarray(
-            [c.is_trusted() for c in self.get_clients()], dtype=bool
+        # run ledger (telemetry/ledger.py): one `started` record now, one
+        # terminal record on the way out — the run is addressable in
+        # results/ledger.jsonl whatever happens next
+        ledger_entry = _ledger.run_started(
+            "simulator", config=run_config, artifacts=[trace_path],
         )
-        attack = self.attack
-        if self._custom_attack_entries:
-            attack = _CompositeAttack(self._custom_attack_entries)
+        # the build/warm-up span (model spec, engine construction,
+        # checkpoint restore, eval warm-up) is the documented cold-
+        # compile crash/hang window; it precedes the round loop's own
+        # handlers, so it needs its own terminal-ledger protection —
+        # a run killed mid-compile must not stay 'open' forever
+        try:
+            spec = self._model_spec(model, loss, compute_dtype)
+            batch_size = train_batch_size or self._train_bs
 
-        self.engine = RoundEngine(
-            spec.train_loss_fn,
-            spec.eval_logits_fn,
-            params,
-            num_clients=self.dataset.num_clients,
-            num_byzantine=self.num_byzantine,
-            attack=attack,
-            aggregator=self.aggregator,
-            client_opt=self._resolve_opt(client_optimizer, ClientOptSpec),
-            server_opt=self._resolve_opt(server_optimizer, ServerOptSpec),
-            num_classes=self._num_classes,
-            trusted_mask=trusted,
-            plan=self.plan,
-            client_chunks=client_chunks,
-            remat=remat,
-            # the [K, D] matrix only needs to be a program output when
-            # someone will read it back (client update views / the
-            # on_round_end observability hook, which documents
-            # engine.last_updates); otherwise keep it in-graph — an output
-            # persists in HBM across rounds
-            keep_updates=retain_updates or on_round_end is not None,
-            donate_batches=donate_batches,
-            collect_diagnostics=collect_diagnostics,
-            fault_model=fault_model,
-            audit_monitor=audit_monitor,
-            streaming=streaming,
-            round_metrics=round_metrics,
-        )
-        # memory observability: the round program's peak update-matrix
-        # footprint rides every round record as gauges (streaming rounds
-        # must show [chunk, D], dense rounds [K, D] — trace_summary.py
-        # surfaces the max, so a regression to dense peaks is visible)
-        rec.gauge("engine.peak_update_bytes", self.engine.peak_update_bytes)
-        rec.gauge("engine.client_chunks", self.engine.client_chunks)
-        rec.gauge("engine.chunk_size", self.engine.chunk_size)
-        rec.gauge("engine.streaming", int(self.engine.streaming))
-        # supervised runs: SIGTERM (the supervisor's first escalation step)
-        # becomes an in-loop exception so the crash autosave below fires
-        # before SIGKILL; main-thread only (signal.signal's constraint).
-        # Installed only AFTER every config-validation error can have
-        # raised (this call + RoundEngine construction above): a build-time
-        # ValueError must never leak the handler process-wide.
-        prev_sigterm = None
-        if (
-            os.environ.get(_heartbeat.SUPERVISED_ENV) == "1"
-            and threading.current_thread() is threading.main_thread()
-        ):
-            def _on_sigterm(signum, frame):
-                raise SupervisorTermination(
-                    "SIGTERM from run supervisor"
-                )
+            key = jax.random.PRNGKey(self.seed)
+            params = spec.init(jax.random.fold_in(key, 17))
 
-            try:
-                prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
-            except (ValueError, OSError):
-                prev_sigterm = None
-        state = self.engine.init(params)
-
-        # crash-autosave target: the explicit checkpoint path when given,
-        # else a fixed path in the log dir — a mid-run exception (OOM, XLA
-        # abort, Ctrl-C on a hung compile) must leave a resumable state, not
-        # lose hours of rounds
-        autosave_path = checkpoint_path or os.path.join(self.log_path, "autosave")
-
-        start_round = 1
-        if resume:
-            for cand in dict.fromkeys((checkpoint_path, autosave_path)):
-                if cand and os.path.exists(checkpoint_file(cand)):
-                    state = self.engine.place_state(restore_state(cand, state))
-                    start_round = int(state.round_idx) + 1
-                    self.debug_logger.info(
-                        f"resumed from {cand} at round {start_round}"
-                    )
-                    break
-        elif checkpoint_path is None:
-            # fresh run: invalidate any leftover IMPLICIT crash autosave in
-            # this log dir NOW (the recovery-aware log-dir wipe preserves
-            # *.npz) — otherwise a supervised relaunch of THIS run
-            # (BLADES_RESUME=1) could resume from a previous experiment's
-            # stale state if this attempt dies before its first autosave.
-            # Never touches a user-configured checkpoint_path.
-            try:
-                stale = checkpoint_file(autosave_path)
-                if os.path.exists(stale):
-                    os.unlink(stale)
-                    self.debug_logger.info(
-                        f"fresh run: removed stale crash autosave {stale}"
-                    )
-            except OSError:
-                pass
-        self.server = BladesServer(self.engine, state, self.aggregator)
-
-        client_lr_fn = self._resolve_schedule(client_lr_scheduler, client_lr)
-        server_lr_fn = self._resolve_schedule(server_lr_scheduler, server_lr)
-
-        # round-block scheduling: fuse the sampler into the round program and
-        # scan block_size rounds per XLA launch (RoundEngine.run_block)
-        block_size = max(1, int(block_size))
-        sampler = None
-        if block_size > 1 and (retain_updates or on_round_end is not None):
-            self.debug_logger.info(
-                "block_size>1 disabled: retain_updates/on_round_end need "
-                "per-round host visibility"
+            trusted = jnp.asarray(
+                [c.is_trusted() for c in self.get_clients()], dtype=bool
             )
-            block_size = 1
-        if block_size > 1:
-            if hasattr(self.dataset, "traceable_sampler"):
-                sampler = self.dataset.traceable_sampler(
-                    local_steps, batch_size
-                )
-            else:
+            attack = self.attack
+            if self._custom_attack_entries:
+                attack = _CompositeAttack(self._custom_attack_entries)
+
+            self.engine = RoundEngine(
+                spec.train_loss_fn,
+                spec.eval_logits_fn,
+                params,
+                num_clients=self.dataset.num_clients,
+                num_byzantine=self.num_byzantine,
+                attack=attack,
+                aggregator=self.aggregator,
+                client_opt=self._resolve_opt(client_optimizer, ClientOptSpec),
+                server_opt=self._resolve_opt(server_optimizer, ServerOptSpec),
+                num_classes=self._num_classes,
+                trusted_mask=trusted,
+                plan=self.plan,
+                client_chunks=client_chunks,
+                remat=remat,
+                # the [K, D] matrix only needs to be a program output when
+                # someone will read it back (client update views / the
+                # on_round_end observability hook, which documents
+                # engine.last_updates); otherwise keep it in-graph — an output
+                # persists in HBM across rounds
+                keep_updates=retain_updates or on_round_end is not None,
+                donate_batches=donate_batches,
+                collect_diagnostics=collect_diagnostics,
+                fault_model=fault_model,
+                audit_monitor=audit_monitor,
+                streaming=streaming,
+                round_metrics=round_metrics,
+            )
+            # memory observability: the round program's peak update-matrix
+            # footprint rides every round record as gauges (streaming rounds
+            # must show [chunk, D], dense rounds [K, D] — trace_summary.py
+            # surfaces the max, so a regression to dense peaks is visible)
+            rec.gauge("engine.peak_update_bytes", self.engine.peak_update_bytes)
+            rec.gauge("engine.client_chunks", self.engine.client_chunks)
+            rec.gauge("engine.chunk_size", self.engine.chunk_size)
+            rec.gauge("engine.streaming", int(self.engine.streaming))
+            # supervised runs: SIGTERM (the supervisor's first escalation step)
+            # becomes an in-loop exception so the crash autosave below fires
+            # before SIGKILL; main-thread only (signal.signal's constraint).
+            # Installed only AFTER every config-validation error can have
+            # raised (this call + RoundEngine construction above): a build-time
+            # ValueError must never leak the handler process-wide.
+            prev_sigterm = None
+            if (
+                os.environ.get(_heartbeat.SUPERVISED_ENV) == "1"
+                and threading.current_thread() is threading.main_thread()
+            ):
+                def _on_sigterm(signum, frame):
+                    raise SupervisorTermination(
+                        "SIGTERM from run supervisor"
+                    )
+
+                try:
+                    prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+                except (ValueError, OSError):
+                    prev_sigterm = None
+            state = self.engine.init(params)
+
+            # crash-autosave target: the explicit checkpoint path when given,
+            # else a fixed path in the log dir — a mid-run exception (OOM, XLA
+            # abort, Ctrl-C on a hung compile) must leave a resumable state, not
+            # lose hours of rounds
+            autosave_path = checkpoint_path or os.path.join(self.log_path, "autosave")
+
+            start_round = 1
+            if resume:
+                for cand in dict.fromkeys((checkpoint_path, autosave_path)):
+                    if cand and os.path.exists(checkpoint_file(cand)):
+                        state = self.engine.place_state(restore_state(cand, state))
+                        start_round = int(state.round_idx) + 1
+                        self.debug_logger.info(
+                            f"resumed from {cand} at round {start_round}"
+                        )
+                        break
+            elif checkpoint_path is None:
+                # fresh run: invalidate any leftover IMPLICIT crash autosave in
+                # this log dir NOW (the recovery-aware log-dir wipe preserves
+                # *.npz) — otherwise a supervised relaunch of THIS run
+                # (BLADES_RESUME=1) could resume from a previous experiment's
+                # stale state if this attempt dies before its first autosave.
+                # Never touches a user-configured checkpoint_path.
+                try:
+                    stale = checkpoint_file(autosave_path)
+                    if os.path.exists(stale):
+                        os.unlink(stale)
+                        self.debug_logger.info(
+                            f"fresh run: removed stale crash autosave {stale}"
+                        )
+                except OSError:
+                    pass
+            self.server = BladesServer(self.engine, state, self.aggregator)
+
+            client_lr_fn = self._resolve_schedule(client_lr_scheduler, client_lr)
+            server_lr_fn = self._resolve_schedule(server_lr_scheduler, server_lr)
+
+            # round-block scheduling: fuse the sampler into the round program and
+            # scan block_size rounds per XLA launch (RoundEngine.run_block)
+            block_size = max(1, int(block_size))
+            sampler = None
+            if block_size > 1 and (retain_updates or on_round_end is not None):
                 self.debug_logger.info(
-                    "block_size>1 disabled: dataset has no traceable_sampler"
+                    "block_size>1 disabled: retain_updates/on_round_end need "
+                    "per-round host visibility"
                 )
                 block_size = 1
+            if block_size > 1:
+                if hasattr(self.dataset, "traceable_sampler"):
+                    sampler = self.dataset.traceable_sampler(
+                        local_steps, batch_size
+                    )
+                else:
+                    self.debug_logger.info(
+                        "block_size>1 disabled: dataset has no traceable_sampler"
+                    )
+                    block_size = 1
 
-        data_key = jax.random.fold_in(key, 23)
-        round_times: List[float] = []
-        global_start = time.time()
-        # profile a ~3-round window, skipping the round-1 compile when the
-        # run is long enough to allow it
-        prof_first = min(max(start_round, 2), global_rounds)
-        prof_last = min(prof_first + 2, global_rounds)
-        trace_active = False
-        # eagerly build the eval executable so its first cold compile never
-        # lands mid-run (the classic between-heartbeat gap under
-        # supervision, and a mid-block stall under round-block scheduling);
-        # skipped when this run will never evaluate
-        if (global_rounds // validate_interval) * validate_interval >= start_round:
-            with rec.span("eval_warmup"):
-                self.engine.warm_eval(
-                    state.params,
-                    self.dataset.test_x,
-                    self.dataset.test_y,
-                    batch_size=test_batch_size,
-                )
+            data_key = jax.random.fold_in(key, 23)
+            round_times: List[float] = []
+            global_start = time.time()
+            # profile a ~3-round window, skipping the round-1 compile when the
+            # run is long enough to allow it
+            prof_first = min(max(start_round, 2), global_rounds)
+            prof_last = min(prof_first + 2, global_rounds)
+            trace_active = False
+            # eagerly build the eval executable so its first cold compile never
+            # lands mid-run (the classic between-heartbeat gap under
+            # supervision, and a mid-block stall under round-block scheduling);
+            # skipped when this run will never evaluate
+            if (global_rounds // validate_interval) * validate_interval >= start_round:
+                with rec.span("eval_warmup"):
+                    self.engine.warm_eval(
+                        state.params,
+                        self.dataset.test_x,
+                        self.dataset.test_y,
+                        batch_size=test_batch_size,
+                    )
+        except BaseException as e:  # noqa: BLE001 - provenance, then re-raise
+            ledger_entry.ended(
+                "crashed" if isinstance(e, Exception) else "killed",
+                error=f"{type(e).__name__}: {e}"[:300],
+                metrics={"rounds_completed": 0},
+            )
+            raise
         try:
             if block_size > 1:
                 self._run_blocks(
@@ -799,6 +854,15 @@ class Simulator:
                 )
             except Exception as save_err:  # noqa: BLE001
                 rec.event("crash_checkpoint_failed", error=str(save_err)[:300])
+            # outcome vocabulary: a real error is `crashed`; an interrupt
+            # or termination (KeyboardInterrupt, SupervisorTermination,
+            # SystemExit — BaseExceptions, not Exceptions) is `killed`,
+            # so runs.py can tell a buggy run from an aborted one
+            ledger_entry.ended(
+                "crashed" if isinstance(e, Exception) else "killed",
+                error=f"{type(e).__name__}: {e}"[:300],
+                metrics={"rounds_completed": len(round_times)},
+            )
             raise
         finally:
             # also reached when a round raises (OOM, XLA abort, Ctrl-C on a
@@ -808,6 +872,23 @@ class Simulator:
             # listeners stay installed for the life of the process).
             rec.event("run_end", rounds_completed=len(round_times))
             rec.flush()
+            # terminal ledger record; idempotent — a crash/kill above
+            # already recorded its outcome and this no-ops
+            ledger_entry.ended(
+                "finished",
+                metrics={
+                    "rounds_completed": len(round_times),
+                    **(
+                        {
+                            "rounds_per_sec": round(
+                                len(round_times) / sum(round_times), 4
+                            )
+                        }
+                        if round_times and sum(round_times) > 0
+                        else {}
+                    ),
+                },
+            )
             if prev_sigterm is not None:
                 try:
                     signal.signal(signal.SIGTERM, prev_sigterm)

@@ -91,6 +91,14 @@ def beat(round_idx: Optional[int] = None, path: Optional[str] = None) -> None:
         return
     now = time.time()
     rec = {"t": "heartbeat", "ts": now, "pid": os.getpid()}
+    # run-identity envelope (telemetry/context.py): a post-mortem can match
+    # the heartbeat body to the trace/ledger of the attempt that wrote it
+    run_id = os.environ.get("BLADES_RUN_ID")
+    if run_id:
+        rec["run_id"] = run_id
+        attempt = os.environ.get("BLADES_ATTEMPT")
+        if attempt and attempt.isdigit():
+            rec["attempt"] = int(attempt)
     if round_idx is not None:
         rec["round"] = int(round_idx)
     # heartbeat-margin gauge: how close did THIS beat come to the

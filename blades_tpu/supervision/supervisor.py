@@ -48,6 +48,7 @@ FL servers (Bonawitz et al., 2019).
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import signal
 import subprocess
@@ -57,6 +58,9 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from blades_tpu.supervision import heartbeat as hb
 from blades_tpu.telemetry import Recorder
+from blades_tpu.telemetry import alerts as _alerts
+from blades_tpu.telemetry import context as _context
+from blades_tpu.telemetry import ledger as _ledger
 
 
 # -- degradation policies -----------------------------------------------------
@@ -236,7 +240,8 @@ class AttemptRecord:
 
     index: int
     returncode: Optional[int]  # None when the watchdog killed the attempt
-    reason: str  # "exit" | "deadline" | "heartbeat_stale" | "startup_stale"
+    # "exit" | "deadline" | "heartbeat_stale" | "startup_stale" | "alert"
+    reason: str
     wall_s: float
     degrade: Tuple[str, ...] = ()
     resumed: bool = False
@@ -270,6 +275,10 @@ class Supervisor:
         env dicts); relaunch ``n`` applies the first ``n - 1`` cumulatively.
     resume : export ``BLADES_RESUME=1`` on relaunches so ``Simulator.run``
         continues from the autosave instead of restarting.
+    kill_on_alert : export ``BLADES_ALERT_FILE`` so a CRITICAL anomaly
+        alert (diverging/non-finite loss — ``telemetry/alerts.py``)
+        recycles the attempt through the same kill -> degrade -> relaunch
+        ladder immediately, instead of waiting for heartbeat staleness.
     telemetry_path : JSONL file the ``supervisor`` records are appended to
         (typically the run's own ``telemetry.jsonl``); None disables.
     heartbeat_file : path the workload beats (exported via
@@ -292,6 +301,7 @@ class Supervisor:
         max_delay_s: float = 60.0,
         degrade: Sequence[Union[str, DegradePolicy, Dict[str, str]]] = (),
         resume: bool = True,
+        kill_on_alert: bool = False,
         telemetry_path: Optional[str] = None,
         heartbeat_file: Optional[str] = None,
         env: Optional[Dict[str, str]] = None,
@@ -328,6 +338,19 @@ class Supervisor:
             )
             heartbeat_file = os.path.join(base or ".", "heartbeat")
         self.heartbeat_file = heartbeat_file
+        self.kill_on_alert = kill_on_alert
+        # the file a CRITICAL alert touches (exported to the child only
+        # under kill_on_alert); lives next to the heartbeat file
+        self.alert_file = os.path.join(
+            os.path.dirname(self.heartbeat_file) or ".", "alert"
+        )
+        # mint the run identity ONCE: every attempt of this supervised run
+        # shares the id; _attempt_env re-exports it with the attempt number
+        # so the child traces and the ledger stitch across relaunches.
+        # fresh=True: an id a PREVIOUS run in this process minted must not
+        # leak into this supervised run (two supervisors in one process are
+        # two runs); a genuinely inherited id (a parent harness) is kept
+        self.ctx = _context.activate(fresh=True)
         self._rec = Recorder(
             path=telemetry_path,
             enabled=telemetry_path is not None,
@@ -347,6 +370,13 @@ class Supervisor:
         env.update(self.env)
         env[hb.SUPERVISED_ENV] = "1"
         env[hb.HEARTBEAT_ENV] = self.heartbeat_file
+        # one run id across every attempt, attempt number incremented per
+        # relaunch (telemetry/context.py): the child recorder stamps both
+        # onto every record, so the stitched trace reads attempts 1..n
+        env[_context.RUN_ID_ENV] = self.ctx.run_id
+        env[_context.ATTEMPT_ENV] = str(attempt)
+        if self.kill_on_alert:
+            env[_alerts.ALERT_FILE_ENV] = self.alert_file
         if self.heartbeat_timeout_s is not None:
             # let the workload measure its own margin against the kill
             # threshold (heartbeat.beat's heartbeat_margin records)
@@ -369,6 +399,11 @@ class Supervisor:
             now = time.monotonic()
             if self.deadline_s is not None and now - t0 > self.deadline_s:
                 return "deadline", None
+            if self.kill_on_alert and os.path.exists(self.alert_file):
+                # a CRITICAL anomaly alert (telemetry/alerts.py): recycle
+                # now — the run is diverging, staleness would waste a
+                # whole heartbeat window first
+                return "alert", None
             if self.heartbeat_timeout_s is not None:
                 age = hb.age_s(self.heartbeat_file)
                 if age is None:
@@ -392,11 +427,13 @@ class Supervisor:
             env, applied = self._attempt_env(attempt)
             resumed = attempt > 1 and self.resume
             # a beat left over from the previous attempt must not read as
-            # fresh liveness for this one
-            try:
-                os.unlink(self.heartbeat_file)
-            except OSError:
-                pass
+            # fresh liveness for this one — nor may a previous attempt's
+            # critical alert instantly kill the relaunch
+            for stale in (self.heartbeat_file, self.alert_file):
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
             if applied:
                 self._event(
                     "degrade", attempt=attempt, policies=applied,
@@ -444,6 +481,13 @@ class Supervisor:
             survivors: Tuple[int, ...] = ()
             if reason != "exit":
                 last = hb.read(self.heartbeat_file) or {}
+                alert = None
+                if reason == "alert":
+                    try:
+                        with open(self.alert_file) as fh:
+                            alert = json.loads(fh.read())
+                    except (OSError, ValueError):
+                        pass
                 info = kill_process_group(proc, term_grace_s=self.term_grace_s)
                 survivors = tuple(info["survivors"])  # type: ignore[arg-type]
                 self._event(
@@ -452,6 +496,16 @@ class Supervisor:
                     survivors=list(survivors),
                     heartbeat_age_s=hb.age_s(self.heartbeat_file),
                     last_round=last.get("round"),
+                    **({"alert": alert} if alert else {}),
+                )
+                # the reaped child never got to write its own ledger exit:
+                # record the kill under the SHARED run id + this attempt
+                _ledger.record_event(
+                    "supervised", "killed",
+                    run_id=self.ctx.run_id, attempt=attempt,
+                    reason=reason,
+                    **({"metrics": {"last_round": last["round"]}}
+                       if isinstance(last.get("round"), int) else {}),
                 )
                 rc = proc.returncode
             last_proc_rc = rc
@@ -526,6 +580,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "cumulatively from the first relaunch on")
     parser.add_argument("--no-resume", action="store_true",
                         help="do not export BLADES_RESUME=1 on relaunches")
+    parser.add_argument("--kill-on-alert", action="store_true",
+                        help="recycle the attempt (through the degrade "
+                             "ladder) the moment the workload emits a "
+                             "CRITICAL anomaly alert (telemetry/alerts.py) "
+                             "instead of waiting for heartbeat staleness")
     parser.add_argument("--heartbeat-file", default=None)
     parser.add_argument("--telemetry", default=None,
                         help="JSONL file for supervisor records (e.g. the "
@@ -566,6 +625,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         poll_s=args.poll,
         degrade=args.degrade,
         resume=not args.no_resume,
+        kill_on_alert=args.kill_on_alert,
         heartbeat_file=args.heartbeat_file,
         telemetry_path=args.telemetry,
     )

@@ -1,0 +1,122 @@
+"""Trace context: a process-tree-wide run identity for every entry point.
+
+Every telemetry trace, bench payload, and evidence row this repo writes
+used to be *anonymous* — correlating a supervised run's kill -> relaunch
+attempts, a bench ladder's children, or a capture window's rows meant
+filename guesswork. The trace context fixes that with two env-propagated
+fields:
+
+- ``run_id`` — minted once at the top of an entry point (Simulator run,
+  ``bench.py`` ladder, ``scripts/certify.py``, ``scripts/chaos.py``,
+  ``scripts/tpu_capture.py``, the run supervisor) and exported as
+  :data:`RUN_ID_ENV` so every child process inherits it;
+- ``attempt`` — 1 by default; the run supervisor re-exports
+  :data:`ATTEMPT_ENV` per relaunch, so all attempts of one supervised run
+  share a ``run_id`` with incrementing attempt numbers.
+
+The :class:`~blades_tpu.telemetry.recorder.Recorder` stamps both onto the
+``meta`` record and every subsequent record's envelope, which makes
+cross-process span trees stitchable by id (``scripts/trace_summary.py``
+surfaces them; ``results/ledger.jsonl`` keys on them).
+
+Inherited-vs-minted discipline: an id found in the environment that THIS
+process minted (tracked in :data:`_minted`) is re-minted on
+``activate(fresh=True)`` — two sequential top-level runs in one process
+are two experiments — while an id inherited from a parent process (the
+supervisor, a bench/capture harness) is never re-minted, because sharing
+it is the whole point.
+
+Stdlib-only and importable before jax (IMP001 contract), like the rest of
+the pre-jax telemetry surface. Reference counterpart: none — the
+reference's runs are anonymous by construction
+(``src/blades/utils.py:67-95`` keys everything on the log directory).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import uuid
+from typing import Optional
+
+#: Env var carrying the run id across the process tree.
+RUN_ID_ENV = "BLADES_RUN_ID"
+
+#: Env var carrying the (supervisor-incremented) attempt number.
+ATTEMPT_ENV = "BLADES_ATTEMPT"
+
+# run ids THIS process minted: an env id in here is ours (re-mintable on a
+# fresh top-level run); an env id not in here was inherited from a parent.
+_minted: set = set()
+
+
+@dataclasses.dataclass(frozen=True)
+class RunContext:
+    """The (run_id, attempt) pair identifying one logical run."""
+
+    run_id: str
+    attempt: int
+    inherited: bool = False
+
+    def env(self) -> dict:
+        """The env-var dict that propagates this context to children."""
+        return {RUN_ID_ENV: self.run_id, ATTEMPT_ENV: str(self.attempt)}
+
+
+def mint_run_id() -> str:
+    """A fresh, human-sortable run id: UTC timestamp + random suffix."""
+    return (
+        time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        + "-"
+        + uuid.uuid4().hex[:6]
+    )
+
+
+def _attempt_from_env() -> int:
+    raw = os.environ.get(ATTEMPT_ENV)
+    try:
+        return max(1, int(raw)) if raw else 1
+    except ValueError:
+        return 1
+
+
+def current() -> Optional[RunContext]:
+    """The active context from the environment, or None when unset."""
+    run_id = os.environ.get(RUN_ID_ENV)
+    if not run_id:
+        return None
+    return RunContext(
+        run_id=run_id,
+        attempt=_attempt_from_env(),
+        inherited=run_id not in _minted,
+    )
+
+
+def activate(fresh: bool = False) -> RunContext:
+    """Return the process run context, minting + exporting when needed.
+
+    ``fresh=True`` (entry points call this): re-mint when the existing
+    env id was minted by THIS process — a new top-level run in the same
+    process is a new experiment. An *inherited* id (exported by a parent:
+    the supervisor, a bench/capture harness) is never re-minted; the
+    attempt number then comes from :data:`ATTEMPT_ENV`.
+    """
+    ctx = current()
+    if ctx is not None and (ctx.inherited or not fresh):
+        return ctx
+    run_id = mint_run_id()
+    _minted.add(run_id)
+    os.environ[RUN_ID_ENV] = run_id
+    os.environ[ATTEMPT_ENV] = "1"
+    return RunContext(run_id=run_id, attempt=1, inherited=False)
+
+
+def envelope() -> dict:
+    """The ``{"run_id": ..., "attempt": ...}`` fields the recorder stamps
+    onto every record (empty when no context is active — a bare Recorder
+    outside any entry point mints its own via :func:`activate`)."""
+    ctx = current()
+    if ctx is None:
+        return {}
+    return {"run_id": ctx.run_id, "attempt": ctx.attempt}

@@ -1,0 +1,386 @@
+"""Run ledger: an append-only, crash-safe registry of every run's
+provenance and outcome.
+
+The repo's evidence artifacts (``results/**``, telemetry traces, bench
+payloads) record *what* a run measured but not *which run it was*: no
+config fingerprint, no code version, no env fingerprint, no outcome. The
+ledger closes that: every entry point appends one ``started`` record at
+construction and one ``finished``/``crashed``/``killed`` record at exit
+to ``results/ledger.jsonl`` (override with :data:`LEDGER_ENV`;
+``BLADES_LEDGER=0`` disables), carrying
+
+- the trace context (``run_id``/``attempt``, ``blades_tpu.telemetry.context``);
+- a **config fingerprint** — stable sha256 of the canonical config dict,
+  so "same experiment, different run" is a string equality;
+- the **code version** (git sha, read from ``.git`` without a subprocess);
+- an **env fingerprint** — python/jax/jaxlib versions, platform, device
+  kind/count when jax is already up (never imported for this), and the
+  probed-XLA-flag verdicts ``utils/platform.py`` caches in the env;
+- outcome, headline metrics, and artifact paths at exit.
+
+I/O discipline matches the recorder's: one buffered write per record (two
+per run), never per-span, and a ledger write never raises — provenance
+must not take down the run it describes. ``scripts/runs.py`` is the query
+CLI; ``scripts/perf_report.py`` ingests the ledger as a run source.
+
+Stdlib-only and importable before jax (IMP001 contract). Reference
+counterpart: none — the reference keeps no record of its runs beyond the
+per-run ``stats`` file (``src/blades/utils.py:67-95``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from blades_tpu.telemetry import context as _context
+
+#: Env var overriding the ledger path; "0" disables ledger writes.
+LEDGER_ENV = "BLADES_LEDGER"
+
+#: Default ledger location (relative to the working directory — the repo
+#: root for every driver gate and harness).
+DEFAULT_PATH = os.path.join("results", "ledger.jsonl")
+
+#: Terminal outcomes a run can record.
+OUTCOMES = ("finished", "crashed", "killed")
+
+
+def ledger_path() -> Optional[str]:
+    """The resolved ledger path, or None when disabled."""
+    raw = os.environ.get(LEDGER_ENV)
+    if raw == "0":
+        return None
+    return raw or DEFAULT_PATH
+
+
+def config_fingerprint(config: Dict[str, Any]) -> str:
+    """Stable short hash of a canonical (JSON-serializable) config dict."""
+    blob = json.dumps(config, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def code_version() -> Optional[str]:
+    """The checked-out git sha, read from ``.git`` directly (no subprocess
+    — this runs inside entry points that must stay cheap). Best-effort:
+    None outside a git checkout."""
+    git = ".git"
+    if not os.path.exists(git):
+        # walk up from this file (harnesses may run with another cwd)
+        here = os.path.dirname(os.path.abspath(__file__))
+        while here != os.path.dirname(here):
+            cand = os.path.join(here, ".git")
+            if os.path.exists(cand):
+                git = cand
+                break
+            here = os.path.dirname(here)
+    try:
+        if os.path.isfile(git):
+            # a `git worktree` checkout: .git is a one-line
+            # "gitdir: <path>" pointer, not a directory
+            with open(git) as fh:
+                pointer = fh.read().strip()
+            if not pointer.startswith("gitdir:"):
+                return None
+            git = os.path.join(
+                os.path.dirname(os.path.abspath(git)),
+                pointer.split(":", 1)[1].strip(),
+            )
+        with open(os.path.join(git, "HEAD")) as fh:
+            head = fh.read().strip()
+        if not head.startswith("ref:"):
+            return head[:40] or None
+        ref = head.split(None, 1)[1]
+        # a worktree gitdir keeps HEAD locally but refs/packed-refs in the
+        # main .git, pointed at by its `commondir` file
+        common = git
+        commondir = os.path.join(git, "commondir")
+        if os.path.isfile(commondir):
+            with open(commondir) as fh:
+                common = os.path.join(git, fh.read().strip())
+        for root in (git, common):
+            ref_path = os.path.join(root, *ref.split("/"))
+            if os.path.exists(ref_path):
+                with open(ref_path) as fh:
+                    return fh.read().strip()[:40] or None
+        packed = os.path.join(common, "packed-refs")
+        with open(packed) as fh:
+            for line in fh:
+                if line.strip().endswith(ref):
+                    return line.split(None, 1)[0][:40]
+    except OSError:
+        pass
+    return None
+
+
+def env_fingerprint() -> Dict[str, Any]:
+    """Best-effort environment fingerprint, without ever importing jax.
+
+    Versions come from package metadata (stdlib ``importlib.metadata``);
+    device/mesh facts are included only when jax is ALREADY in
+    ``sys.modules`` and a backend is up; the probed-XLA-flag verdicts are
+    the ``_BLADES_XLA_FLAG_*`` env cache ``utils/platform.py`` maintains.
+    """
+    import platform as _platform
+
+    fp: Dict[str, Any] = {
+        "python": _platform.python_version(),
+        "platform": sys.platform,
+    }
+    try:
+        from importlib import metadata
+
+        for pkg in ("jax", "jaxlib"):
+            try:
+                fp[pkg] = metadata.version(pkg)
+            except metadata.PackageNotFoundError:
+                pass
+    except Exception:  # noqa: BLE001 - fingerprinting is best-effort
+        pass
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            devices = jax_mod.devices()
+            fp["device_kind"] = getattr(devices[0], "device_kind", None) or (
+                devices[0].platform
+            )
+            fp["device_platform"] = devices[0].platform
+            fp["n_devices"] = len(devices)
+        except Exception:  # noqa: BLE001 - backend may be down/uninitialized
+            pass
+    flags = {
+        k[len("_BLADES_XLA_FLAG_"):]: v == "1"
+        for k, v in os.environ.items()
+        if k.startswith("_BLADES_XLA_FLAG_")
+    }
+    if flags:
+        fp["xla_flag_probes"] = flags
+    return fp
+
+
+def _append(path: str, record: Dict[str, Any]) -> bool:
+    """One buffered append of one JSONL record; never raises."""
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a") as fh:
+            fh.write(json.dumps(record, default=repr) + "\n")
+        return True
+    except (OSError, TypeError, ValueError):
+        return False
+
+
+class LedgerEntry:
+    """Handle for one run's ledger lifecycle: ``started`` at construction
+    (via :func:`run_started`), exactly one terminal record via
+    :meth:`ended` (idempotent — the first outcome wins, so a crash path
+    followed by a finally block cannot double-record)."""
+
+    def __init__(self, path: Optional[str], record: Dict[str, Any]):
+        self.path = path
+        self.record = record
+        self.t0 = time.time()
+        self._closed = False
+
+    def ended(
+        self,
+        outcome: str = "finished",
+        metrics: Optional[Dict[str, Any]] = None,
+        error: Optional[str] = None,
+        artifacts: Optional[List[str]] = None,
+    ) -> Optional[Dict[str, Any]]:
+        if self._closed or self.path is None:
+            return None
+        self._closed = True
+        rec: Dict[str, Any] = {
+            "t": "ledger",
+            "event": outcome if outcome in OUTCOMES else "finished",
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "run_id": self.record["run_id"],
+            "attempt": self.record["attempt"],
+            "kind": self.record["kind"],
+            "wall_s": round(time.time() - self.t0, 3),
+        }
+        if metrics:
+            rec["metrics"] = metrics
+        if error:
+            rec["error"] = str(error)[:500]
+        if artifacts:
+            rec["artifacts"] = list(artifacts)
+        _append(self.path, rec)
+        return rec
+
+
+def run_started(
+    kind: str,
+    config: Optional[Dict[str, Any]] = None,
+    artifacts: Optional[List[str]] = None,
+    path: Optional[str] = None,
+    **fields: Any,
+) -> LedgerEntry:
+    """Append this run's ``started`` record; returns the entry handle.
+
+    ``kind`` names the entry point (``simulator``/``bench``/``certify``/
+    ``chaos``/``tpu_capture``/``supervised``); ``config`` is the canonical
+    config dict the fingerprint hashes (also stored verbatim when small).
+    Disabled (``BLADES_LEDGER=0``) returns an inert handle.
+    """
+    target = path or ledger_path()
+    ctx = _context.activate()
+    rec: Dict[str, Any] = {
+        "t": "ledger",
+        "event": "started",
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "run_id": ctx.run_id,
+        "attempt": ctx.attempt,
+        "kind": kind,
+        "env": env_fingerprint(),
+    }
+    sha = code_version()
+    if sha:
+        # omitted (not null) outside a git checkout: the schema's closed
+        # `ledger` type declares code_version as an optional STRING
+        rec["code_version"] = sha
+    if config is not None:
+        rec["config_fingerprint"] = config_fingerprint(config)
+        if len(json.dumps(config, default=repr)) <= 2000:
+            rec["config"] = config
+    if artifacts:
+        rec["artifacts"] = list(artifacts)
+    rec.update(fields)
+    entry = LedgerEntry(target if target else None, rec)
+    if target:
+        _append(target, rec)
+    return entry
+
+
+def record_event(
+    kind: str,
+    event: str,
+    run_id: Optional[str] = None,
+    attempt: Optional[int] = None,
+    path: Optional[str] = None,
+    **fields: Any,
+) -> Optional[Dict[str, Any]]:
+    """Append a standalone ledger record (the supervisor's ``killed``
+    record for a watchdog-reaped child that never got to write its own
+    exit). Never raises; returns the record or None when disabled."""
+    target = path or ledger_path()
+    if not target:
+        return None
+    ctx = _context.current()
+    rec: Dict[str, Any] = {
+        "t": "ledger",
+        "event": event if event in OUTCOMES or event == "started" else "killed",
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "run_id": run_id or (ctx.run_id if ctx else "unknown"),
+        "attempt": attempt if attempt is not None else (ctx.attempt if ctx else 1),
+        "kind": kind,
+    }
+    rec.update(fields)
+    _append(target, rec)
+    return rec
+
+
+def read_ledger(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Parse a ledger file (skips blank/torn lines — a live run may be
+    mid-append); [] when missing/disabled."""
+    target = path or ledger_path()
+    out: List[Dict[str, Any]] = []
+    if not target or not os.path.exists(target):
+        return out
+    try:
+        with open(target) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def pair_runs(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Join started/terminal records into one summary dict per run attempt
+    (``outcome`` is None while still open).
+
+    Keyed by (run_id, attempt, kind): one propagated run id legitimately
+    spans several entry points (a capture harness AND the bench ladder it
+    launches both ledger under the inherited id), and merging their
+    records would corrupt both. Each ``started`` record opens a NEW slot
+    for its key — several sequential same-kind runs inside one inherited
+    process are several runs, paired in record order, never merged. A
+    standalone terminal record with no open slot of its own kind — the
+    supervisor's ``killed`` for a reaped child — closes the same-attempt
+    sibling slots that are still open instead of surfacing as a phantom
+    run."""
+    runs: Dict[tuple, List[Dict[str, Any]]] = {}
+
+    def _new_slot(rec: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "run_id": rec.get("run_id"),
+            "attempt": rec.get("attempt"),
+            "kind": rec.get("kind"),
+            "outcome": None,
+        }
+
+    orphans: List[Dict[str, Any]] = []  # terminal records with no started
+    for rec in records:
+        if rec.get("t") != "ledger":
+            continue
+        key = (rec.get("run_id"), rec.get("attempt"), rec.get("kind"))
+        slots = runs.setdefault(key, [])
+        if rec.get("event") == "started":
+            slot = _new_slot(rec)
+            slots.append(slot)
+            for field in ("ts", "config_fingerprint", "code_version",
+                          "config", "artifacts", "env"):
+                if field in rec:
+                    slot[field] = rec[field]
+            continue
+        # terminal record: pair with this key's latest still-open slot
+        open_slots = [s for s in slots if s["outcome"] is None]
+        if open_slots:
+            slot = open_slots[-1]
+        else:
+            slot = _new_slot(rec)
+            orphans.append(slot)
+        slot["outcome"] = rec.get("event")
+        for field in ("wall_s", "metrics", "error"):
+            if field in rec:
+                slot[field] = rec[field]
+        if "artifacts" in rec and "artifacts" not in slot:
+            slot["artifacts"] = rec["artifacts"]
+    out: List[Dict[str, Any]] = []
+    for slots in runs.values():
+        out.extend(slots)
+    for slot in orphans:
+        # the watchdog's record for a reaped child: propagate the outcome
+        # to still-open sibling slots of the same (run_id, attempt), and
+        # keep the orphan itself only when nothing absorbed it
+        siblings = [
+            s for (rid, att, _kind), ss in runs.items()
+            for s in ss
+            if (rid, att) == (slot["run_id"], slot["attempt"])
+            and s["outcome"] is None
+        ]
+        for s in siblings:
+            s["outcome"] = slot["outcome"]
+            for field in ("metrics", "error"):
+                if field in slot and field not in s:
+                    s[field] = slot[field]
+        if not siblings:
+            out.append(slot)
+    return out

@@ -38,7 +38,9 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
+
+from blades_tpu.telemetry import context as _context
 
 
 def telemetry_enabled() -> bool:
@@ -112,11 +114,23 @@ class Recorder:
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, Any] = {}
         self.dropped = 0
+        #: optional per-record observer (the anomaly alert engine,
+        #: ``telemetry/alerts.py``): called from :meth:`_emit` with each
+        #: record as it enters the in-memory buffer — pure python, no I/O,
+        #: so the flush-once-per-round discipline is untouched
+        self.observer: Optional[Callable[[Dict[str, Any]], None]] = None
         self._stack: list = []
         self._pending: list = []  # records not yet flushed to the sink
         self._fh = None
         self._last_counts: Dict[str, float] = {}
+        #: run-identity envelope stamped onto every record (trace context,
+        #: ``telemetry/context.py``): cross-process span trees become
+        #: stitchable by run_id instead of filename guesswork. Minted on
+        #: demand for enabled recorders; disabled recorders never touch it.
+        self._envelope: Dict[str, Any] = {}
         if self.enabled:
+            ctx = _context.activate()
+            self._envelope = {"run_id": ctx.run_id, "attempt": ctx.attempt}
             rec: Dict[str, Any] = {"t": "meta", "ts": time.time(), "pid": os.getpid()}
             if meta:
                 rec.update(meta)
@@ -177,7 +191,22 @@ class Recorder:
     # -- sink -----------------------------------------------------------------
 
     def _emit(self, record: Dict[str, Any]) -> None:
+        for k, v in self._envelope.items():
+            # setdefault: a record carrying its own field of the same name
+            # (the supervisor's per-event `attempt`) wins over the envelope
+            record.setdefault(k, v)
         self._pending.append(record)
+        obs = self.observer
+        if obs is not None:
+            try:
+                # the alert engine: may emit `alert` records back into this
+                # recorder (alerts are not in its watched set, so no
+                # recursion, and they land AFTER their trigger — the
+                # triggering record is already buffered above); a broken
+                # rule must never take down the run
+                obs(record)
+            except Exception:  # noqa: BLE001 - observability must not raise
+                pass
         if len(self._pending) > self.max_buffer:
             # bound the buffer, never the run. Applies to file-backed
             # recorders too: one that stops being flushed (e.g. a run

@@ -47,7 +47,12 @@ def load_schema(path: Optional[str] = None) -> Dict[str, Any]:
 
 
 def validate_record(rec: Dict[str, Any], schema: Dict[str, Any]) -> List[str]:
-    """Errors for one parsed record (empty list == valid)."""
+    """Errors for one parsed record (empty list == valid).
+
+    The schema's top-level ``envelope`` declares the run-identity fields
+    (``run_id``/``attempt``, ``telemetry/context.py``) the recorder stamps
+    onto EVERY record: they are implicitly optional on every type —
+    including closed (``extra: false``) ones — but still type-checked."""
     t = rec.get("t")
     if not isinstance(t, str):
         return [f"record has no string 't' field: {rec!r:.120}"]
@@ -58,6 +63,21 @@ def validate_record(rec: Dict[str, Any], schema: Dict[str, Any]) -> List[str]:
             " (and docs/observability.md)"
         ]
     errors = []
+    envelope = schema.get("envelope", {})
+    for field, ftype in envelope.items():
+        # an envelope name shadowed by the type's own declaration (the
+        # supervisor's per-event `attempt`) is validated by that
+        # declaration below, not here
+        if (
+            field in rec
+            and field not in spec.get("required", {})
+            and field not in spec.get("optional", {})
+            and not _CHECKS[ftype](rec[field])
+        ):
+            errors.append(
+                f"{t}.{field}: envelope field expected {ftype}, got "
+                f"{type(rec[field]).__name__} ({rec[field]!r:.60})"
+            )
     for field, ftype in spec.get("required", {}).items():
         if field not in rec:
             errors.append(f"{t}: missing required field {field!r}")
@@ -74,7 +94,10 @@ def validate_record(rec: Dict[str, Any], schema: Dict[str, Any]) -> List[str]:
             )
     if not spec.get("extra", True):
         declared = (
-            {"t"} | set(spec.get("required", {})) | set(spec.get("optional", {}))
+            {"t"}
+            | set(envelope)
+            | set(spec.get("required", {}))
+            | set(spec.get("optional", {}))
         )
         for field in rec:
             if field not in declared:

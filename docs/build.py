@@ -64,6 +64,7 @@ GALLERY = [
      ["--rounds", "2", "--out", "@TMP@", "--aggs", "median"], {}, 900),
     ("defense_audit.py", ["--rounds", "2", "--out", "@TMP@"], {}, 900),
     ("supervised_run.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
+    ("run_ledger.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
     ("streaming_clients.py",
      ["--rounds", "2", "--clients", "12", "--out", "@TMP@"], {}, 900),
     ("fedavg_ipm.py",
@@ -87,6 +88,9 @@ API_MODULES = [
     "blades_tpu.telemetry.metric_pack",
     "blades_tpu.telemetry.profiling",
     "blades_tpu.telemetry.schema",
+    "blades_tpu.telemetry.context",
+    "blades_tpu.telemetry.ledger",
+    "blades_tpu.telemetry.alerts",
     "blades_tpu.simulator",
     "blades_tpu.client",
     "blades_tpu.server",
@@ -144,6 +148,10 @@ def run_example(name: str, argv: list, extra_env: dict, timeout: int,
     extra_env = {k: v.replace("@TMP@", tmp) for k, v in extra_env.items()}
     env = dict(os.environ)
     env.update(CPU_ENV)
+    # reduced doc-build runs are not provenance: their ledger records land
+    # in the build tmpdir, never the committed results/ledger.jsonl
+    # (run_ledger.py overrides this with its own demo ledger)
+    env["BLADES_LEDGER"] = os.path.join(tmp, "ledger.jsonl")
     env.update(extra_env)  # per-example overrides win (e.g. MESH_FLAGS)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.run(

@@ -211,6 +211,24 @@ def main() -> int:
                                                  "certification"))
     args = p.parse_args()
 
+    # run identity + ledger (stdlib-only): the cert matrix is a committed
+    # evidence artifact — make the run that produced it addressable
+    from blades_tpu.telemetry import context as _context
+    from blades_tpu.telemetry import ledger as _ledger
+
+    _context.activate(fresh=True)
+    ledger_entry = _ledger.run_started(
+        "certify",
+        config={
+            "kind": "certify",
+            "clients": args.clients,
+            "dim": args.dim,
+            "trials": args.trials,
+            "seed": args.seed,
+            "quick": bool(args.quick),
+            "aggs": sorted(args.aggs) if args.aggs else None,
+        },
+    )
     try:
         from blades_tpu.utils.platform import apply_env_platform
 
@@ -237,11 +255,21 @@ def main() -> int:
             "artifact": os.path.relpath(artifact, REPO),
             "ok": matrix["ok"],
         }
+        ledger_entry.ended(
+            "finished",
+            metrics={
+                "cells": summary["cells"],
+                "certified_cells": summary["certified_cells"],
+                "ok": summary["ok"],
+            },
+            artifacts=[summary["artifact"]],
+        )
         print(json.dumps(summary))
         return 0 if matrix["ok"] else 1
     except SystemExit:
         raise
     except Exception as e:  # noqa: BLE001 - the contract IS the catch-all
+        ledger_entry.ended("crashed", error=f"{type(e).__name__}: {e}")
         print(json.dumps({
             "metric": METRIC,
             "ok": False,

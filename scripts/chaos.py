@@ -441,13 +441,33 @@ def main() -> int:
     args = p.parse_args()
 
     if args.child:
+        # supervised children inherit the supervisor's run id via env
+        # (telemetry/context.py); their Simulator writes the ledger records
         child_main(args)
         return 0
     n = args.sweep if args.sweep is not None else 24
+    from blades_tpu.telemetry import context as _context
+    from blades_tpu.telemetry import ledger as _ledger
     from blades_tpu.utils.platform import apply_env_platform
 
+    _context.activate(fresh=True)
+    ledger_entry = _ledger.run_started(
+        "chaos", config={"kind": "chaos", "scenarios": n},
+    )
     apply_env_platform()
-    summary = sweep(n, args.out)
+    try:
+        summary = sweep(n, args.out)
+    except Exception as e:
+        ledger_entry.ended("crashed", error=f"{type(e).__name__}: {e}")
+        raise
+    ledger_entry.ended(
+        "finished",
+        metrics={
+            "scenarios": summary["scenarios"],
+            "violations": len(summary["violations"]),
+            "ok": summary["ok"],
+        },
+    )
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1
 

@@ -1,0 +1,232 @@
+"""Run-ledger query CLI: who ran what, when, with which config, and how
+it ended — plus tunnel-availability windows from the probe log.
+
+Reads the append-only provenance ledger (``results/ledger.jsonl``,
+``blades_tpu/telemetry/ledger.py``) and prints ONE JSON line (the
+``bench.py``/``certify.py`` driver contract) summarizing the recorded
+runs: counts by kind and outcome, open (started-but-unterminated) runs,
+distinct config fingerprints, and the most recent attempts. With
+``--run-id`` the line carries that run's full attempt trail instead.
+With ``--tunnel`` it additionally summarizes the TPU tunnel probe log
+(``results/tpu_r5/tunnel_probes.jsonl``, written by
+``scripts/tpu_capture.py``) into availability windows — up fraction,
+window counts, longest up/down stretch — quantifying the ROADMAP
+standing item's vigil.
+
+Usage::
+
+    python scripts/runs.py                          # summarize the ledger
+    python scripts/runs.py --ledger PATH --latest 5
+    python scripts/runs.py --run-id 20260804T...    # one run's trail
+    python scripts/runs.py --tunnel results/tpu_r5/tunnel_probes.jsonl
+
+Stdlib-only, no jax import — runs on any host, tunnel up or down.
+Reference counterpart: none — the reference keeps no registry of its
+runs at all (``src/blades/utils.py:67-95``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+METRIC = "runs"
+
+
+def summarize_runs(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Roll a parsed ledger up into the summary payload's `runs` block."""
+    from blades_tpu.telemetry.ledger import pair_runs
+
+    runs = pair_runs(records)
+    by_kind: Dict[str, int] = {}
+    by_outcome: Dict[str, int] = {}
+    fingerprints: Dict[str, int] = {}
+    for r in runs:
+        by_kind[r.get("kind") or "?"] = by_kind.get(r.get("kind") or "?", 0) + 1
+        outcome = r.get("outcome") or "open"
+        by_outcome[outcome] = by_outcome.get(outcome, 0) + 1
+        fp = r.get("config_fingerprint")
+        if fp:
+            fingerprints[fp] = fingerprints.get(fp, 0) + 1
+    return {
+        "runs": len(runs),
+        "by_kind": by_kind,
+        "by_outcome": by_outcome,
+        "open": by_outcome.get("open", 0),
+        "distinct_configs": len(fingerprints),
+        "records": len([r for r in records if r.get("t") == "ledger"]),
+        "_paired": runs,  # stripped before printing; --latest/--run-id read it
+    }
+
+
+def latest_rows(runs: List[Dict[str, Any]], n: int) -> List[Dict[str, Any]]:
+    """The n most recent run attempts, compacted for the one-line payload."""
+    def ts(r):
+        return r.get("ts") or 0
+
+    out = []
+    for r in sorted(runs, key=ts, reverse=True)[:n]:
+        row = {
+            "run_id": r.get("run_id"),
+            "attempt": r.get("attempt"),
+            "kind": r.get("kind"),
+            "outcome": r.get("outcome") or "open",
+        }
+        for field in ("config_fingerprint", "wall_s", "error"):
+            if field in r:
+                row[field] = (
+                    r[field][:120] if field == "error" else r[field]
+                )
+        metrics = r.get("metrics") or {}
+        for field in ("rounds_per_sec", "value", "rounds_completed"):
+            if metrics.get(field) is not None:
+                row[field] = metrics[field]
+        out.append(row)
+    return out
+
+
+def summarize_tunnel(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Availability windows from timestamped up/down probe records.
+
+    Each inter-probe interval is attributed to the state its *starting*
+    probe observed (the only honest reading of a sampled signal); a
+    "window" is a maximal run of same-state probes.
+    """
+    probes = sorted(
+        (r for r in records
+         if r.get("t") == "tunnel_probe" and isinstance(r.get("ts"), (int, float))),
+        key=lambda r: r["ts"],
+    )
+    if not probes:
+        return {"probes": 0}
+    up_probes = sum(1 for p in probes if p.get("up"))
+    windows: List[Dict[str, Any]] = []
+    for p in probes:
+        state = bool(p.get("up"))
+        if windows and windows[-1]["up"] == state:
+            windows[-1]["end_ts"] = p["ts"]
+            windows[-1]["probes"] += 1
+        else:
+            if windows:
+                # the interval crossing the transition belongs to the
+                # state its STARTING probe observed: close the previous
+                # window at this probe's ts, so windows tile the whole
+                # observed span (an alternating flaky log must not
+                # collapse every window to a zero-length point)
+                windows[-1]["end_ts"] = p["ts"]
+            windows.append(
+                {"up": state, "start_ts": p["ts"], "end_ts": p["ts"],
+                 "probes": 1}
+            )
+    up_s = down_s = 0.0
+    for w in windows:
+        span = w["end_ts"] - w["start_ts"]
+        if w["up"]:
+            up_s += span
+        else:
+            down_s += span
+    observed = up_s + down_s
+    up_windows = [w for w in windows if w["up"]]
+    down_windows = [w for w in windows if not w["up"]]
+    return {
+        "probes": len(probes),
+        "up_probes": up_probes,
+        "up_probe_frac": round(up_probes / len(probes), 4),
+        "observed_s": round(observed, 1),
+        "up_time_frac": round(up_s / observed, 4) if observed else None,
+        "up_windows": len(up_windows),
+        "down_windows": len(down_windows),
+        "longest_up_s": round(
+            max((w["end_ts"] - w["start_ts"] for w in up_windows), default=0.0), 1
+        ),
+        "longest_down_s": round(
+            max((w["end_ts"] - w["start_ts"] for w in down_windows), default=0.0), 1
+        ),
+        "last_up": bool(probes[-1].get("up")),
+        "last_ts": probes[-1]["ts"],
+    }
+
+
+def _run(argv: Optional[List[str]] = None) -> int:
+    from blades_tpu.telemetry.ledger import (
+        DEFAULT_PATH,
+        LEDGER_ENV,
+        read_ledger,
+    )
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--ledger", default=None,
+                   help=f"ledger path (default: $BLADES_LEDGER or "
+                        f"{DEFAULT_PATH})")
+    p.add_argument("--run-id", default=None,
+                   help="emit this run's full attempt trail")
+    p.add_argument("--latest", type=int, default=5,
+                   help="how many recent attempts to inline (default 5)")
+    p.add_argument("--tunnel", default=None, metavar="PROBES_JSONL",
+                   help="also summarize a tunnel-probe log into "
+                        "availability windows")
+    args = p.parse_args(argv)
+
+    target = args.ledger
+    if not target and not os.environ.get(LEDGER_ENV):
+        # the repo's ledger, wherever this CLI was invoked from — the
+        # cwd-relative default would silently report an empty ledger
+        # with ok:true when run outside the repo root
+        target = os.path.join(REPO, DEFAULT_PATH)
+    records = read_ledger(target)
+    summary = summarize_runs(records)
+    paired = summary.pop("_paired")
+    payload: Dict[str, Any] = {"metric": METRIC, **summary}
+    if args.ledger:
+        payload["ledger"] = args.ledger
+
+    if args.run_id:
+        trail = sorted(
+            (r for r in paired if r.get("run_id") == args.run_id),
+            key=lambda r: r.get("attempt") or 0,
+        )
+        payload["run_id"] = args.run_id
+        payload["attempts"] = [
+            {k: v for k, v in r.items() if k not in ("env", "config")}
+            for r in trail
+        ]
+        payload["found"] = bool(trail)
+    else:
+        payload["latest"] = latest_rows(paired, args.latest)
+
+    if args.tunnel:
+        # read_ledger is the one torn-line-tolerant JSONL reader (a live
+        # watcher may be mid-append); a missing probe log degrades to an
+        # empty summary, not an error — no probes is a valid observation
+        payload["tunnel"] = summarize_tunnel(read_ledger(args.tunnel))
+
+    payload["ok"] = True
+    print(json.dumps(payload))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """One-JSON-line contract, unconditionally (the ``bench.py``
+    discipline): even a bug in the query itself must reach the driver as
+    a single parseable error line, never a traceback-only death."""
+    try:
+        return _run(argv)
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 - the contract IS the catch-all
+        print(json.dumps({
+            "metric": METRIC,
+            "ok": False,
+            "error": f"{type(e).__name__}: {e}"[:1000],
+        }))
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

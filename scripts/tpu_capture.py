@@ -26,9 +26,15 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "results", "tpu_r5")
 ROWS = os.path.join(OUT, "rows.jsonl")
+# timestamped up/down record per tunnel probe: the ROADMAP standing
+# item's vigil, quantified (scripts/runs.py --tunnel summarizes the
+# availability windows)
+PROBES = os.path.join(OUT, "tunnel_probes.jsonl")
 
 sys.path.insert(0, REPO)
 from blades_tpu.supervision.supervisor import kill_process_group  # noqa: E402  (stdlib-only)
+from blades_tpu.telemetry import context as run_context  # noqa: E402  (stdlib-only)
+from blades_tpu.telemetry import ledger as run_ledger  # noqa: E402  (stdlib-only)
 from blades_tpu.utils.retry import retry_call  # noqa: E402
 
 
@@ -71,13 +77,31 @@ def run(cmd, timeout, env=None):
         )
 
 
-def tunnel_alive(timeout=90):
+def record_probe(up, wall_s=None, source="capture"):
+    """Persist one probe outcome as a timestamped up/down record
+    (``tunnel_probes.jsonl``) — every probe burned against the tunnel
+    becomes availability-window evidence instead of a throwaway stdout
+    line. Never raises: probe accounting must not break the probe."""
+    rec = {"t": "tunnel_probe", "ts": time.time(), "up": bool(up),
+           "source": source}
+    if wall_s is not None:
+        rec["wall_s"] = round(wall_s, 3)
+    try:
+        os.makedirs(os.path.dirname(PROBES), exist_ok=True)
+        with open(PROBES, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
+def tunnel_alive(timeout=90, source="capture"):
     """Cheap liveness probe in a throwaway subprocess (a hung backend init
     must never poison this process). Observed 2026-07-31: up-windows can be
     under a minute, so the capture re-probes before every measurement and
     bails fast instead of burning each child's full timeout against a dead
     tunnel — the watcher loop re-fires the (resumable) capture at the next
-    window."""
+    window. Every outcome is persisted via :func:`record_probe`."""
+    t0 = time.time()
     rc, out, _ = run(
         [sys.executable, "-c",
          "import jax; jax.jit(lambda x: x + 1)(jax.numpy.zeros(4))"
@@ -87,6 +111,7 @@ def tunnel_alive(timeout=90):
     # accept both spellings of the accelerator platform (bench.py likewise
     # treats "tpu" and "axon" as on-accelerator)
     ok = rc == 0 and ("ALIVE tpu" in out or "ALIVE axon" in out)
+    record_probe(ok, wall_s=time.time() - t0, source=source)
     if ok:
         global _last_alive
         _last_alive = time.time()
@@ -543,6 +568,25 @@ def main():
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         # shared liveness entry point for tpu_watch.sh: one copy of the
-        # probe command and platform-accept list instead of a shell twin
-        sys.exit(0 if tunnel_alive() else 1)
-    main()
+        # probe command and platform-accept list instead of a shell twin;
+        # every outcome lands in tunnel_probes.jsonl (record_probe)
+        sys.exit(0 if tunnel_alive(source="watch") else 1)
+    # run identity + ledger: one id per capture invocation, inherited by
+    # every bench child via env, so a window's rows stitch to their run
+    run_context.activate(fresh=True)
+    _entry = run_ledger.run_started(
+        "tpu_capture", config={"kind": "tpu_capture"},
+        artifacts=[os.path.relpath(ROWS, REPO)],
+    )
+    try:
+        main()
+    except SystemExit as e:
+        # exit 2 == resumable bail (tunnel died / artifacts pending): the
+        # capture invocation itself still finished cleanly
+        _entry.ended("finished", metrics={"exit": int(e.code or 0)})
+        raise
+    except BaseException as e:  # noqa: BLE001 - crash provenance
+        _entry.ended("crashed", error=f"{type(e).__name__}: {e}")
+        raise
+    else:
+        _entry.ended("finished", metrics={"exit": 0})

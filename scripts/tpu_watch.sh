@@ -20,6 +20,8 @@ while [ $((SECONDS - START)) -lt "$BUDGET" ]; do
   # surviving probe would hold the single-chip lease for the whole budget.
   # The probe itself is tpu_capture.tunnel_alive (one copy of the command
   # and the accepted platform list); its inner subprocess timeout is 90 s.
+  # Every outcome is persisted to results/tpu_r5/tunnel_probes.jsonl
+  # (summarize availability windows: python scripts/runs.py --tunnel ...).
   if timeout -k 10 110 python scripts/tpu_capture.py --probe 2>/dev/null; then
     echo "TPU ALIVE at $(date -u), capturing..."
     # timeout -k backstop: the capture now killpg's its own timed-out
@@ -51,6 +53,7 @@ while [ $((SECONDS - START)) -lt "$BUDGET" ]; do
              results/tpu_r5/headline_attempts.jsonl \
              results/tpu_r5/stages_attempts.jsonl \
              results/tpu_r5/headline_interim.json \
+             results/tpu_r5/tunnel_probes.jsonl results/ledger.jsonl \
              results/tpu_r5/profile results/bench_tpu.json; do
       [ -e "$f" ] && evid+=("$f")
     done

@@ -58,11 +58,19 @@ def summarize(records: List[dict]) -> dict:
     programs = []
     profile_events = []
     margins = []
+    alerts = []
     supervisor: Dict[str, int] = {}
     kill_reasons = []
     meta = {}
+    # run identity (telemetry/context.py): every record carries the
+    # run_id/attempt envelope; a supervised trace stitches several
+    # attempts of ONE run_id, so collect the attempt set per id
+    run_attempts: Dict[str, set] = {}
     for r in records:
         t = r.get("t")
+        rid = r.get("run_id")
+        if isinstance(rid, str):
+            run_attempts.setdefault(rid, set()).add(r.get("attempt"))
         if t == "span":
             s = spans.setdefault(
                 r["path"], {"count": 0, "total_s": 0.0, "max_s": 0.0}
@@ -88,6 +96,8 @@ def summarize(records: List[dict]) -> dict:
             profile_events.append(r)
         elif t == "heartbeat_margin":
             margins.append(r)
+        elif t == "alert":
+            alerts.append(r)
         elif t == "supervisor":
             ev = r.get("event", "?")
             supervisor[ev] = supervisor.get(ev, 0) + 1
@@ -231,8 +241,49 @@ def summarize(records: List[dict]) -> dict:
         )
         heartbeat_summary["timeout_s"] = margins[-1].get("timeout_s")
 
+    # run-identity rollup: who this trace belongs to. A normal trace has
+    # one run_id and one attempt; a supervised stitched trace has one id
+    # with attempts 1..n; multiple ids mean concatenated unrelated runs.
+    run_summary: Dict[str, object] = {}
+    if run_attempts:
+        ids = sorted(run_attempts)
+        primary = meta.get("run_id") or ids[0]
+        run_summary["run_id"] = primary
+        run_summary["attempts"] = sorted(
+            a for a in run_attempts.get(primary, set()) if isinstance(a, int)
+        )
+        if len(ids) > 1:
+            run_summary["other_run_ids"] = [i for i in ids if i != primary]
+    if "config_fingerprint" in meta:
+        run_summary["config_fingerprint"] = meta["config_fingerprint"]
+
+    # anomaly alerts (telemetry/alerts.py): each rule fires at most once
+    # per run, so the rollup is small by construction
+    alert_summary: Dict[str, object] = {}
+    if alerts:
+        alert_summary["count"] = len(alerts)
+        by_sev: Dict[str, int] = {}
+        for a in alerts:
+            sev = a.get("severity", "?")
+            by_sev[sev] = by_sev.get(sev, 0) + 1
+        alert_summary["by_severity"] = by_sev
+        alert_summary["rules"] = sorted(
+            {a.get("rule", "?") for a in alerts}
+        )
+        first_critical = next(
+            (a for a in alerts if a.get("severity") == "critical"), None
+        )
+        if first_critical:
+            alert_summary["first_critical"] = {
+                k: first_critical.get(k)
+                for k in ("rule", "round", "message")
+                if k in first_critical
+            }
+
     return {
         "meta": meta,
+        "run": run_summary,
+        "alerts": alert_summary,
         "spans": spans,
         "counters": counters,
         "memory": memory_summary,
@@ -263,6 +314,21 @@ def format_table(summary: dict) -> str:
     """The human-readable per-stage cost table."""
     lines = []
     meta = summary["meta"]
+    run = summary.get("run") or {}
+    if run.get("run_id"):
+        parts = [f"run_id: {run['run_id']}"]
+        attempts = run.get("attempts") or []
+        if attempts and attempts != [1]:
+            parts.append(f"attempts {attempts[0]}..{attempts[-1]}")
+        if run.get("config_fingerprint"):
+            parts.append(f"config {run['config_fingerprint']}")
+        lines.append("  ".join(parts))
+        if run.get("other_run_ids"):
+            lines.append(
+                f"  NOTE: trace also contains records from "
+                f"{len(run['other_run_ids'])} other run id(s): "
+                f"{', '.join(run['other_run_ids'])}"
+            )
     if meta:
         cfg = ", ".join(
             f"{k}={meta[k]}"
@@ -373,6 +439,17 @@ def format_table(summary: dict) -> str:
             for k, v in sorted(aud.items())
         )
         lines.append(f"audit: {pairs}")
+    al = summary.get("alerts") or {}
+    if al:
+        sev = ", ".join(
+            f"{k}={v}" for k, v in sorted(al.get("by_severity", {}).items())
+        )
+        lines.append(
+            f"ALERTS: {al['count']} ({sev}): {', '.join(al.get('rules', []))}"
+        )
+        fc = al.get("first_critical")
+        if fc:
+            lines.append(f"  first critical: {fc.get('message')}")
     sup = summary.get("supervisor") or {}
     if sup.get("events"):
         pairs = ", ".join(f"{k}={v}" for k, v in sorted(sup["events"].items()))
@@ -388,6 +465,21 @@ def compare_format(sa: dict, sb: dict, la: str = "A", lb: str = "B") -> str:
     lines = []
     lines.append(f"A = {la}")
     lines.append(f"B = {lb}")
+    for label, s in (("A", sa), ("B", sb)):
+        run = s.get("run") or {}
+        if run.get("run_id"):
+            fp = run.get("config_fingerprint")
+            lines.append(
+                f"  {label}: run_id {run['run_id']}"
+                + (f"  config {fp}" if fp else "")
+            )
+    for label, s in (("A", sa), ("B", sb)):
+        al = s.get("alerts") or {}
+        if al:
+            lines.append(
+                f"  {label}: ALERTS {al['count']}: "
+                f"{', '.join(al.get('rules', []))}"
+            )
     ra, rb = sa["rounds"], sb["rounds"]
     lines.append(
         f"{'':<28}{'A':>12}{'B':>12}{'B/A':>8}\n"
@@ -449,6 +541,10 @@ def main(argv=None) -> int:
     p.add_argument("--compare", action="store_true",
                    help="diff two traces' cost tables and counters "
                         "side by side")
+    p.add_argument("--force", action="store_true",
+                   help="compare traces even when their config fingerprints "
+                        "differ (default: refuse — a diff of two different "
+                        "experiments is noise dressed as signal)")
     args = p.parse_args(argv)
     if args.compare:
         if len(args.trace) != 2:
@@ -461,6 +557,30 @@ def main(argv=None) -> int:
                 print(f"no records in {path}", file=sys.stderr)
                 return 1
             summaries.append(summarize(records))
+        # config-fingerprint guard (telemetry/ledger.py): the same
+        # experiment hashes to the same fingerprint, so a mismatch means
+        # the diff would compare unrelated runs. Refuse unless --force;
+        # traces predating the fingerprint (either side missing) only warn.
+        fps = [
+            (s.get("run") or {}).get("config_fingerprint") for s in summaries
+        ]
+        if fps[0] and fps[1] and fps[0] != fps[1]:
+            msg = (
+                f"config fingerprints differ: A={fps[0]} B={fps[1]} — "
+                "these traces are from different experiment configs"
+            )
+            if not args.force:
+                print(f"REFUSING to compare: {msg} (use --force to override)",
+                      file=sys.stderr)
+                return 2
+            print(f"WARNING: {msg} (--force given, comparing anyway)",
+                  file=sys.stderr)
+        elif not (fps[0] and fps[1]):
+            print("WARNING: config fingerprint missing from "
+                  + ("both traces" if not (fps[0] or fps[1])
+                     else ("trace A" if not fps[0] else "trace B"))
+                  + " (pre-run-identity trace?) — cannot verify the runs "
+                    "share one experiment config", file=sys.stderr)
         if args.as_json:
             print(json.dumps({"a": summaries[0], "b": summaries[1]}))
         else:

@@ -12,6 +12,18 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Run-provenance hygiene: hundreds of tests construct Simulators (and
+# subprocess children inherit this env), and their ledger records must
+# land in a throwaway per-session file — never the repo's committed
+# results/ledger.jsonl. Tests that assert ledger behavior pass their own
+# explicit path (or override BLADES_LEDGER themselves).
+if "BLADES_LEDGER" not in os.environ:
+    import tempfile
+
+    os.environ["BLADES_LEDGER"] = os.path.join(
+        tempfile.mkdtemp(prefix="blades_test_ledger_"), "ledger.jsonl"
+    )
+
 from blades_tpu.utils.platform import force_virtual_cpu  # noqa: E402
 
 force_virtual_cpu(8)

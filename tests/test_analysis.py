@@ -185,6 +185,59 @@ def test_imp_rules_catch_relative_imports(tmp_path):
     ]
 
 
+def test_imp001_covers_run_identity_modules(tmp_path):
+    """PR 9 surface: the run-identity layer (`telemetry/{context,ledger,
+    alerts}.py`) entered the pre-jax contract set — a module-scope jax
+    import in any of them must fire IMP001 (the fire direction; HEAD
+    silence is test_tier_a_silent_on_head)."""
+    tel = tmp_path / "blades_tpu" / "telemetry"
+    tel.mkdir(parents=True)
+    for name in ("context", "ledger", "alerts"):
+        (tel / f"{name}.py").write_text(
+            '"""Doc. Reference counterpart: none — test module."""\n'
+            "import jax\n"
+        )
+    violations, _ = run_rules(RepoIndex(str(tmp_path)), all_rules())
+    assert sorted(v.path for v in violations if v.rule == "IMP001") == [
+        "blades_tpu/telemetry/alerts.py",
+        "blades_tpu/telemetry/context.py",
+        "blades_tpu/telemetry/ledger.py",
+    ], [str(v) for v in violations]
+
+
+def test_json001_covers_runs_script(tmp_path):
+    """PR 9 surface: `scripts/runs.py` (the ledger query CLI) entered the
+    one-JSON-line contract set — a main() without the catch-all funnel
+    must fire JSON001."""
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "runs.py").write_text(textwrap.dedent(
+        '''\
+        """Doc. Reference counterpart: none — test module."""
+        import json
+
+
+        def main():
+            print(json.dumps({"ok": True}))  # no try/except catch-all
+        '''
+    ))
+    violations, _ = run_rules(RepoIndex(str(tmp_path)), all_rules())
+    assert [v.rule for v in violations] == ["JSON001"], [
+        str(v) for v in violations
+    ]
+
+
+def test_schema001_sees_new_record_emitters_on_head():
+    """PR 9 surface: the static emit scan must actually SEE the new
+    emitters — `alert` (telemetry/alerts.py via rec.event) and `ledger`
+    (telemetry/ledger.py via {"t": ...} literals). Without this, schema
+    coverage of the new types would rest on the declaration alone."""
+    from blades_tpu.analysis.rules.schema_drift import emitted_types
+
+    emitted = {t for t, _, _ in emitted_types(RepoIndex(REPO))}
+    assert {"alert", "ledger"} <= emitted, sorted(emitted)
+
+
 def test_alias001_catches_with_statement_load(tmp_path):
     """Regression (review finding): `with np.load(path) as z:` is the
     documented numpy idiom for NpzFile and must taint the bound archive
@@ -379,6 +432,17 @@ def test_import_telemetry_before_jax():
 
 def test_import_supervision_before_jax():
     proc = _import_probe("import blades_tpu.supervision")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_import_run_identity_modules_before_jax():
+    """The run-identity layer (context/ledger/alerts) is consumed by
+    stdlib-only harnesses (supervisor, tpu_capture, runs.py) — importing
+    it must never pull in jax."""
+    proc = _import_probe(
+        "import blades_tpu.telemetry.context, blades_tpu.telemetry.ledger, "
+        "blades_tpu.telemetry.alerts"
+    )
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 

@@ -34,6 +34,7 @@ def cap(tmp_path):
     spec.loader.exec_module(mod)
     mod.OUT = str(tmp_path)
     mod.ROWS = str(tmp_path / "rows.jsonl")
+    mod.PROBES = str(tmp_path / "tunnel_probes.jsonl")  # not the repo's
     mod.HEAD_FAILS = str(tmp_path / "headline_attempts.jsonl")
     mod.STAGES_PATH = str(tmp_path / "stages.json")
     mod.STAGE_FAILS = str(tmp_path / "stages_attempts.jsonl")

@@ -1,0 +1,907 @@
+"""Run identity & health layer tests (``blades_tpu/telemetry/{context,
+ledger,alerts}.py`` + the supervisor/simulator wiring): run-id mint/
+inherit semantics, the crash-safe provenance ledger, the record envelope
+on every telemetry record, the anomaly-alert rules (firing on seeded
+unhealthy streams, silent on healthy ones), cross-process correlation
+under the supervisor's kill -> relaunch ladder, and the ``runs.py`` /
+``trace_summary.py`` query surfaces.
+
+Reference counterpart: none — the reference's runs are anonymous by
+construction (``src/blades/utils.py:67-95`` keys everything on the log
+directory) and it has no runtime health signal of any kind.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from blades_tpu.supervision.supervisor import supervise  # noqa: E402
+from blades_tpu.telemetry import alerts, context, ledger  # noqa: E402
+from blades_tpu.telemetry.recorder import Recorder  # noqa: E402
+
+
+def _records(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+@pytest.fixture()
+def clean_ctx(monkeypatch):
+    """A process whose run context is unset: no env id, nothing minted —
+    the state every fresh top-level entry point starts from."""
+    monkeypatch.delenv(context.RUN_ID_ENV, raising=False)
+    monkeypatch.delenv(context.ATTEMPT_ENV, raising=False)
+    monkeypatch.setattr(context, "_minted", set())
+    return monkeypatch
+
+
+# ------------------------------------------------------------- trace context
+
+
+def test_activate_mints_and_exports(clean_ctx):
+    ctx = context.activate(fresh=True)
+    assert ctx.run_id and ctx.attempt == 1 and not ctx.inherited
+    assert os.environ[context.RUN_ID_ENV] == ctx.run_id
+    assert os.environ[context.ATTEMPT_ENV] == "1"
+    assert context.envelope() == {"run_id": ctx.run_id, "attempt": 1}
+
+
+def test_fresh_remints_own_id_but_keeps_inherited(clean_ctx):
+    first = context.activate(fresh=True)
+    # two sequential top-level runs in one process are two experiments
+    second = context.activate(fresh=True)
+    assert second.run_id != first.run_id
+    # a non-fresh activate (the recorder) adopts whatever is active
+    assert context.activate().run_id == second.run_id
+    # an id exported by a PARENT process is never re-minted: sharing it
+    # across the supervisor's attempts is the whole point
+    clean_ctx.setenv(context.RUN_ID_ENV, "parent-id")
+    clean_ctx.setenv(context.ATTEMPT_ENV, "3")
+    clean_ctx.setattr(context, "_minted", set())
+    ctx = context.activate(fresh=True)
+    assert ctx.run_id == "parent-id" and ctx.attempt == 3 and ctx.inherited
+
+
+def test_envelope_empty_without_context(clean_ctx):
+    assert context.current() is None
+    assert context.envelope() == {}
+
+
+def test_run_ids_sort_by_mint_time(clean_ctx):
+    a = context.mint_run_id()
+    b = context.mint_run_id()
+    assert a[:15] <= b[:15]  # UTC-timestamp prefix is human-sortable
+
+
+# ---------------------------------------------------------------- run ledger
+
+
+def test_config_fingerprint_stable_and_key_order_insensitive():
+    a = ledger.config_fingerprint({"x": 1, "y": [2, 3]})
+    b = ledger.config_fingerprint({"y": [2, 3], "x": 1})
+    c = ledger.config_fingerprint({"x": 1, "y": [2, 4]})
+    assert a == b != c and len(a) == 12
+
+
+def test_ledger_started_finished_pair(clean_ctx, tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    clean_ctx.setenv(ledger.LEDGER_ENV, path)
+    entry = ledger.run_started("simulator", config={"k": 6}, artifacts=["a"])
+    entry.ended("finished", metrics={"rounds_completed": 2})
+    recs = ledger.read_ledger(path)
+    assert [r["event"] for r in recs] == ["started", "finished"]
+    started, finished = recs
+    assert started["run_id"] == finished["run_id"] == os.environ[
+        context.RUN_ID_ENV
+    ]
+    assert started["config_fingerprint"] == ledger.config_fingerprint(
+        {"k": 6}
+    )
+    assert started["config"] == {"k": 6} and started["artifacts"] == ["a"]
+    assert "env" in started and started["env"].get("python")
+    assert finished["metrics"] == {"rounds_completed": 2}
+    assert finished["wall_s"] >= 0
+    # terminal record is idempotent: first outcome wins (a crash handler
+    # followed by the finally block must not double-record)
+    assert entry.ended("finished") is None
+    assert len(ledger.read_ledger(path)) == 2
+
+
+def test_ledger_crash_beats_finally_finished(clean_ctx, tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    clean_ctx.setenv(ledger.LEDGER_ENV, path)
+    entry = ledger.run_started("simulator")
+    entry.ended("crashed", error="boom")
+    entry.ended("finished")  # the finally block, after the except path
+    recs = ledger.read_ledger(path)
+    assert [r["event"] for r in recs] == ["started", "crashed"]
+    assert recs[1]["error"] == "boom"
+
+
+def test_ledger_disabled_is_inert(clean_ctx, tmp_path):
+    clean_ctx.setenv(ledger.LEDGER_ENV, "0")
+    entry = ledger.run_started("bench", config={"a": 1})
+    assert entry.path is None
+    assert entry.ended("finished") is None
+    assert ledger.record_event("bench", "killed") is None
+    assert ledger.ledger_path() is None
+
+
+def test_read_ledger_skips_torn_lines(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    path.write_text(
+        '{"t": "ledger", "event": "started", "run_id": "r", "attempt": 1}\n'
+        '{"t": "ledger", "ev'  # a live run mid-append
+    )
+    recs = ledger.read_ledger(str(path))
+    assert len(recs) == 1 and recs[0]["event"] == "started"
+
+
+def test_pair_runs_joins_by_run_and_attempt():
+    recs = [
+        {"t": "ledger", "event": "started", "run_id": "r", "attempt": 1,
+         "kind": "simulator", "ts": 1.0, "config_fingerprint": "fp"},
+        {"t": "ledger", "event": "killed", "run_id": "r", "attempt": 1,
+         "kind": "supervised"},
+        {"t": "ledger", "event": "started", "run_id": "r", "attempt": 2,
+         "kind": "simulator", "ts": 2.0, "config_fingerprint": "fp"},
+        {"t": "ledger", "event": "finished", "run_id": "r", "attempt": 2,
+         "kind": "simulator", "wall_s": 3.0,
+         "metrics": {"rounds_per_sec": 4.0}},
+        {"t": "ledger", "event": "started", "run_id": "other", "attempt": 1,
+         "kind": "bench", "ts": 3.0},
+    ]
+    runs = {(r["run_id"], r["attempt"]): r for r in ledger.pair_runs(recs)}
+    assert len(runs) == 3
+    assert runs[("r", 1)]["outcome"] == "killed"
+    assert runs[("r", 2)]["outcome"] == "finished"
+    assert runs[("r", 2)]["metrics"]["rounds_per_sec"] == 4.0
+    assert runs[("other", 1)]["outcome"] is None  # still open
+
+
+def test_pair_runs_keeps_shared_id_entry_points_apart():
+    """Review finding: one propagated run id legitimately spans several
+    entry points (tpu_capture mints, its bench ladder inherits) — their
+    records must pair into separate per-kind runs, not one garbage slot."""
+    recs = [
+        {"t": "ledger", "event": "started", "run_id": "r", "attempt": 1,
+         "kind": "tpu_capture", "ts": 1.0},
+        {"t": "ledger", "event": "started", "run_id": "r", "attempt": 1,
+         "kind": "bench", "ts": 2.0, "config_fingerprint": "fpb"},
+        {"t": "ledger", "event": "finished", "run_id": "r", "attempt": 1,
+         "kind": "bench", "metrics": {"rounds_per_sec": 9.9}},
+        {"t": "ledger", "event": "finished", "run_id": "r", "attempt": 1,
+         "kind": "tpu_capture", "metrics": {"exit": 0}},
+    ]
+    runs = {r["kind"]: r for r in ledger.pair_runs(recs)}
+    assert len(runs) == 2
+    assert runs["bench"]["outcome"] == "finished"
+    assert runs["bench"]["metrics"] == {"rounds_per_sec": 9.9}
+    assert runs["bench"]["config_fingerprint"] == "fpb"
+    assert runs["tpu_capture"]["metrics"] == {"exit": 0}
+
+
+def test_pair_runs_sequential_same_kind_runs_stay_apart():
+    """Review finding: a supervised child hosting TWO sequential runs of
+    one kind under its inherited (run_id, attempt) is two runs — each
+    `started` opens a new slot, terminals pair in record order."""
+    base = {"t": "ledger", "run_id": "r", "attempt": 1, "kind": "simulator"}
+    recs = [
+        dict(base, event="started", ts=1.0, config_fingerprint="fp1"),
+        dict(base, event="crashed", error="boom"),
+        dict(base, event="started", ts=2.0, config_fingerprint="fp2"),
+        dict(base, event="finished", metrics={"rounds_completed": 3}),
+    ]
+    runs = sorted(ledger.pair_runs(recs), key=lambda r: r["ts"])
+    assert len(runs) == 2
+    assert runs[0]["outcome"] == "crashed"
+    assert runs[0]["config_fingerprint"] == "fp1"
+    assert runs[1]["outcome"] == "finished"
+    assert runs[1]["config_fingerprint"] == "fp2"
+
+
+def test_run_started_omits_code_version_outside_git(clean_ctx, tmp_path,
+                                                    monkeypatch):
+    """Review finding: outside a git checkout the started record must
+    OMIT code_version (the closed `ledger` schema type declares it as an
+    optional string — null fails the validator)."""
+    from blades_tpu.telemetry.schema import load_schema, validate_records
+
+    path = str(tmp_path / "ledger.jsonl")
+    clean_ctx.setenv(ledger.LEDGER_ENV, path)
+    monkeypatch.setattr(ledger, "code_version", lambda: None)
+    ledger.run_started("bench").ended("finished")
+    recs = ledger.read_ledger(path)
+    assert "code_version" not in recs[0]
+    assert validate_records(recs, load_schema()) == []
+
+
+def test_code_version_matches_git_head():
+    sha = ledger.code_version()
+    assert sha and len(sha) == 40
+    head = subprocess.run(
+        ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+        cwd=REPO,
+    ).stdout.strip()
+    if head:
+        assert sha == head
+
+
+# -------------------------------------------------------- recorder envelope
+
+
+def test_recorder_stamps_envelope_on_every_record(clean_ctx, tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    rec = Recorder(path=path, meta={"run": "x"})
+    with rec.span("round"):
+        pass
+    rec.event("run_end", rounds_completed=0)
+    rec.round_record(0, wall_s=0.1)
+    rec.close()
+    recs = _records(path)
+    assert len(recs) >= 4
+    rid = os.environ[context.RUN_ID_ENV]
+    for r in recs:
+        assert r["run_id"] == rid and r["attempt"] == 1, r
+
+
+def test_record_own_field_wins_over_envelope(clean_ctx, tmp_path):
+    """The supervisor's per-event `attempt` (attempt N of the ladder) must
+    not be clobbered by the recorder process's own envelope attempt."""
+    path = str(tmp_path / "t.jsonl")
+    rec = Recorder(path=path)
+    rec.event("supervisor", event="kill", attempt=3)
+    rec.close()
+    sup = [r for r in _records(path) if r.get("t") == "supervisor"]
+    assert sup[0]["attempt"] == 3
+
+
+def test_disabled_recorder_touches_no_context(clean_ctx, tmp_path):
+    rec = Recorder(path=str(tmp_path / "t.jsonl"), enabled=False)
+    rec.event("run_end")
+    rec.close()
+    assert context.current() is None  # no mint, no env export
+    assert not os.path.exists(str(tmp_path / "t.jsonl"))
+
+
+# ------------------------------------------------------------- alert engine
+
+
+def _rounds(losses=(), walls=(), compiles=None, margins=None):
+    recs = []
+    for i, loss in enumerate(losses):
+        r = {"t": "round", "round": i, "train_loss": loss,
+             "counters": {}, "gauges": {}}
+        if walls:
+            r["wall_s"] = walls[i]
+        if compiles and i in compiles:
+            r["counters"]["xla.compiles"] = compiles[i]
+        if margins and i < len(margins):
+            r["gauges"]["heartbeat.margin_s"] = margins[i]
+        recs.append(r)
+    return recs
+
+
+def test_alert_loss_nonfinite():
+    out = alerts.evaluate_records(_rounds(losses=[1.0, float("nan")]))
+    assert [a["rule"] for a in out] == ["loss_nonfinite"]
+    assert out[0]["severity"] == "critical" and out[0]["t"] == "alert"
+
+
+def test_alert_loss_divergence_fires_once():
+    losses = [1.0, 1.0, 1.0, 5.0, 5.0, 5.0, 9.0, 9.0, 9.0]
+    out = alerts.evaluate_records(_rounds(losses=losses))
+    assert [a["rule"] for a in out] == ["loss_divergence"]  # once per run
+    assert out[0]["severity"] == "critical"
+
+
+def test_alert_silent_on_converging_loss():
+    losses = [1.0, 0.9, 0.8, 0.7, 0.65, 0.6, 0.58, 0.55]
+    assert alerts.evaluate_records(_rounds(losses=losses)) == []
+
+
+def test_alert_norm_collapse():
+    hist_bad = [0, 1, 0, 0, 9]  # 90% of mass in the top (largest) bin
+    hist_ok = [2, 5, 2, 1, 0]
+    out = alerts.evaluate_records(
+        [{"t": "metrics", "round": 1, "norm_hist": hist_ok},
+         {"t": "metrics", "round": 2, "norm_hist": hist_bad}]
+    )
+    assert [a["rule"] for a in out] == ["norm_collapse"]
+    assert out[0]["round"] == 2
+
+
+def test_alert_audit_breach_storm():
+    healthy = [{"t": "audit", "round": i, "breach": 0} for i in range(8)]
+    assert alerts.evaluate_records(healthy) == []
+    stormy = [
+        {"t": "audit", "round": i, "breach": 1 if i >= 4 else 0}
+        for i in range(8)
+    ]
+    out = alerts.evaluate_records(stormy)
+    assert [a["rule"] for a in out] == ["audit_breach_storm"]
+
+
+def test_alert_compile_storm_after_warmup():
+    # compiles during the first rounds are warm-up, not a storm
+    warm = _rounds(losses=[1.0] * 4, compiles={0: 5, 1: 2})
+    assert alerts.evaluate_records(warm) == []
+    # ONE late compile-bearing round is the documented first-eval build
+    late_eval = _rounds(losses=[1.0] * 6, compiles={0: 5, 4: 2})
+    assert alerts.evaluate_records(late_eval) == []
+    # a SECOND one is a storm
+    storm = _rounds(losses=[1.0] * 8, compiles={0: 5, 4: 2, 6: 1})
+    out = alerts.evaluate_records(storm)
+    assert [a["rule"] for a in out] == ["compile_storm"]
+    assert out[0]["round"] == 6
+
+
+def test_alert_throughput_drop_vs_own_median():
+    walls = [0.1] * 8 + [0.9]
+    out = alerts.evaluate_records(
+        _rounds(losses=[1.0] * 9, walls=walls)
+    )
+    assert [a["rule"] for a in out] == ["throughput_drop"]
+    steady = _rounds(losses=[1.0] * 9, walls=[0.1] * 9)
+    assert alerts.evaluate_records(steady) == []
+
+
+def test_alert_heartbeat_margin_rules():
+    out = alerts.evaluate_records(
+        [{"t": "heartbeat_margin", "round": 3, "interval_s": 9.0,
+          "margin_s": 1.0, "timeout_s": 10.0}]
+    )
+    assert [a["rule"] for a in out] == ["heartbeat_margin_low"]
+    shrink = _rounds(losses=[1.0] * 4, margins=[8.0, 6.0, 4.0, 2.0])
+    out = alerts.evaluate_records(shrink)
+    assert [a["rule"] for a in out] == ["heartbeat_margin_shrinking"]
+    steady = _rounds(losses=[1.0] * 4, margins=[8.0, 7.9, 8.1, 8.0])
+    assert alerts.evaluate_records(steady) == []
+
+
+def test_alert_records_ride_recorder_and_validate(clean_ctx, tmp_path):
+    """Live wiring: the engine observes records as they enter the buffer,
+    the alert record lands in the SAME trace behind the same envelope,
+    and it validates against the committed schema."""
+    from blades_tpu.telemetry.schema import load_schema, validate_records
+
+    path = str(tmp_path / "t.jsonl")
+    rec = Recorder(path=path, meta={"run": "x"})
+    engine = alerts.install(rec)
+    assert engine is not None
+    rec.round_record(0, train_loss=float("inf"), wall_s=0.1)
+    rec.close()
+    recs = _records(path)
+    alert = [r for r in recs if r["t"] == "alert"]
+    assert len(alert) == 1 and alert[0]["rule"] == "loss_nonfinite"
+    assert alert[0]["run_id"] == os.environ[context.RUN_ID_ENV]
+    assert validate_records(recs, load_schema()) == []
+
+
+def test_alerts_disabled_by_env(clean_ctx, tmp_path):
+    clean_ctx.setenv(alerts.ALERTS_ENV, "0")
+    rec = Recorder(path=str(tmp_path / "t.jsonl"))
+    assert alerts.install(rec) is None
+    rec.close()
+
+
+def test_install_on_disabled_recorder_is_none(clean_ctx, tmp_path):
+    rec = Recorder(path=str(tmp_path / "t.jsonl"), enabled=False)
+    assert alerts.install(rec) is None
+
+
+def test_critical_alert_touches_supervisor_hook_file(clean_ctx, tmp_path):
+    hook = tmp_path / "alert"
+    clean_ctx.setenv(alerts.ALERT_FILE_ENV, str(hook))
+    # offline replay must NEVER signal a running supervisor
+    alerts.evaluate_records(_rounds(losses=[float("nan")]))
+    assert not hook.exists()
+    # a live engine (recorder attached) does
+    rec = Recorder(path=str(tmp_path / "t.jsonl"))
+    alerts.install(rec)
+    rec.round_record(0, train_loss=float("nan"))
+    rec.close()
+    body = json.loads(hook.read_text())
+    assert body["rule"] == "loss_nonfinite" and body["severity"] == "critical"
+    # warn-severity alerts never touch the hook
+    hook.unlink()
+    rec2 = Recorder(path=str(tmp_path / "t2.jsonl"))
+    alerts.install(rec2)
+    for i, w in enumerate([0.1] * 8 + [0.9]):
+        rec2.round_record(i, train_loss=1.0, wall_s=w)
+    rec2.close()
+    assert not hook.exists()
+
+
+def test_malformed_records_never_disable_alerting():
+    recs = [
+        {"t": "round"},  # no loss, no wall
+        {"t": "metrics", "norm_hist": "not-a-list"},
+        {"t": "audit", "breach": "nope"},
+        {"t": "round", "round": 5, "train_loss": float("nan")},
+    ]
+    out = alerts.evaluate_records(recs)
+    assert [a["rule"] for a in out] == ["loss_nonfinite"]
+
+
+def test_alerts_silent_on_committed_artifacts():
+    """The committed evidence record streams under results/ describe
+    healthy runs; replaying the rule set over them must raise nothing."""
+    import glob
+
+    streams = 0
+    for path in glob.glob(os.path.join(REPO, "results", "**", "*.jsonl"),
+                          recursive=True):
+        recs = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    recs.append(rec)
+        if recs:
+            streams += 1
+            assert alerts.evaluate_records(recs) == [], path
+    assert streams >= 2  # the committed evidence ladders exist
+
+
+# ------------------------------------------- supervised cross-process runs
+
+
+def test_supervised_attempts_share_run_id(clean_ctx, tmp_path):
+    """The acceptance correlation property: every attempt of one
+    supervised run inherits ONE run id with incrementing attempt numbers,
+    and the supervisor's own telemetry records carry the same envelope."""
+    probe = tmp_path / "attempts.jsonl"
+    code = (
+        "import json, os, sys\n"
+        "with open(%r, 'a') as f:\n"
+        "    f.write(json.dumps({'rid': os.environ.get('BLADES_RUN_ID'),\n"
+        "        'att': os.environ.get('BLADES_ATTEMPT')}) + '\\n')\n"
+        "sys.exit(1)" % str(probe)
+    )
+    telem = tmp_path / "telemetry.jsonl"
+    result = supervise(
+        [sys.executable, "-c", code],
+        attempts=3, base_delay_s=0.01, poll_s=0.05,
+        heartbeat_file=str(tmp_path / "hb"),
+        telemetry_path=str(telem),
+    )
+    assert not result.ok and len(result.attempts) == 3
+    rows = _records(str(probe))
+    rids = {r["rid"] for r in rows}
+    assert len(rids) == 1 and None not in rids
+    assert [r["att"] for r in rows] == ["1", "2", "3"]
+    (rid,) = rids
+    for r in _records(str(telem)):
+        assert r["run_id"] == rid, r
+
+
+def test_watchdog_kill_writes_ledger_record(clean_ctx, tmp_path):
+    """A reaped child never writes its own ledger exit — the supervisor
+    records the kill under the shared run id + attempt."""
+    led = str(tmp_path / "ledger.jsonl")
+    clean_ctx.setenv(ledger.LEDGER_ENV, led)
+    beat_then_hang = (
+        "import sys, time; sys.path.insert(0, %r); "
+        "from blades_tpu.supervision.heartbeat import beat; "
+        "beat(round_idx=2); time.sleep(600)" % REPO
+    )
+    result = supervise(
+        [sys.executable, "-c", beat_then_hang],
+        heartbeat_timeout_s=1.0, startup_grace_s=30.0, attempts=1,
+        term_grace_s=0.5, poll_s=0.1,
+        heartbeat_file=str(tmp_path / "hb"),
+        telemetry_path=str(tmp_path / "telemetry.jsonl"),
+    )
+    assert result.attempts[0].reason == "heartbeat_stale"
+    kills = [r for r in ledger.read_ledger(led) if r["event"] == "killed"]
+    assert len(kills) == 1
+    assert kills[0]["kind"] == "supervised"
+    assert kills[0]["run_id"] == os.environ[context.RUN_ID_ENV]
+    assert kills[0]["attempt"] == 1
+    assert kills[0]["reason"] == "heartbeat_stale"
+    assert kills[0]["metrics"] == {"last_round": 2}
+
+
+def test_kill_on_alert_recycles_through_degrade_ladder(clean_ctx, tmp_path):
+    """The supervisor hook: a CRITICAL anomaly alert (seeded non-finite
+    loss) kills the attempt with reason 'alert' — in seconds, not after a
+    heartbeat-staleness window — and the relaunch walks the degrade
+    ladder; both attempts' traces stitch under one run id."""
+    trace = str(tmp_path / "child_trace.jsonl")
+    code = (
+        "import sys, time; sys.path.insert(0, %r)\n"
+        "from blades_tpu.telemetry.recorder import Recorder\n"
+        "from blades_tpu.telemetry import alerts\n"
+        "from blades_tpu.supervision.heartbeat import beat\n"
+        "rec = Recorder(path=%r, meta={'run': 'diverging'})\n"
+        "alerts.install(rec)\n"
+        "beat(round_idx=0)\n"
+        "rec.round_record(0, train_loss=float('nan'), wall_s=0.1)\n"
+        "rec.flush()\n"
+        "for i in range(1, 200):\n"
+        "    time.sleep(0.1); beat(round_idx=i)\n" % (REPO, trace)
+    )
+    telem = tmp_path / "telemetry.jsonl"
+    result = supervise(
+        [sys.executable, "-c", code],
+        attempts=2, base_delay_s=0.01, poll_s=0.1,
+        heartbeat_timeout_s=30.0, startup_grace_s=30.0, term_grace_s=0.5,
+        kill_on_alert=True, degrade=["single_device"],
+        heartbeat_file=str(tmp_path / "hb"),
+        telemetry_path=str(telem),
+    )
+    assert [a.reason for a in result.attempts] == ["alert", "alert"]
+    assert result.attempts[1].degrade == ("single_device",)
+    # the kill event carries the triggering alert body
+    kills = [r for r in _records(str(telem))
+             if r.get("t") == "supervisor" and r.get("event") == "kill"]
+    assert len(kills) == 2
+    assert kills[0]["alert"]["rule"] == "loss_nonfinite"
+    # both attempts' child traces share the supervisor's run id with
+    # incrementing attempt numbers — stitchable by id, no filename games
+    child = _records(trace)
+    rid = os.environ[context.RUN_ID_ENV]
+    assert {r["run_id"] for r in child} == {rid}
+    assert {r["attempt"] for r in child} == {1, 2}
+    for r in child:
+        if r["t"] == "alert":
+            assert r["rule"] == "loss_nonfinite"
+
+
+def test_supervisor_remints_a_process_local_id(clean_ctx, tmp_path):
+    """Review finding: an id a previous run in THIS process minted must
+    not leak into a new supervised run; a genuinely inherited id must."""
+    from blades_tpu.supervision.supervisor import Supervisor
+
+    stale = context.activate(fresh=True)  # e.g. an earlier Simulator run
+    sup = Supervisor(["true"], heartbeat_file=str(tmp_path / "hb"))
+    assert sup.ctx.run_id != stale.run_id
+    # inherited (parent-exported) ids are kept — sharing is the point
+    clean_ctx.setenv(context.RUN_ID_ENV, "parent-id")
+    clean_ctx.setattr(context, "_minted", set())
+    sup2 = Supervisor(["true"], heartbeat_file=str(tmp_path / "hb2"))
+    assert sup2.ctx.run_id == "parent-id"
+
+
+def test_build_phase_crash_still_ledgers_crashed(tmp_path, monkeypatch):
+    """Review finding: a crash in the build/warm-up span (the documented
+    cold-compile crash window, before the round loop's own handlers) must
+    not leave the run 'open' in the ledger forever."""
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import Synthetic
+
+    led = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv(ledger.LEDGER_ENV, led)
+    ds = Synthetic(num_clients=4, train_size=120, test_size=40, cache=False)
+    sim = Simulator(ds, log_path=str(tmp_path / "out"), seed=0,
+                    aggregator="mean")
+    with pytest.raises(Exception):
+        sim.run("no_such_model", global_rounds=1, local_steps=1,
+                train_batch_size=8)
+    recs = ledger.read_ledger(led)
+    assert [r["event"] for r in recs] == ["started", "crashed"]
+    assert recs[1]["metrics"] == {"rounds_completed": 0}
+
+
+def test_kill_on_alert_off_ignores_alert_file(clean_ctx, tmp_path):
+    """Without the hook the supervisor must NOT export the alert file —
+    a critical alert then changes nothing about process lifetime."""
+    probe = tmp_path / "env.json"
+    code = (
+        "import json, os; open(%r, 'w').write(json.dumps("
+        "os.environ.get('BLADES_ALERT_FILE')))" % str(probe)
+    )
+    result = supervise(
+        [sys.executable, "-c", code],
+        attempts=1, poll_s=0.05,
+        heartbeat_file=str(tmp_path / "hb"),
+    )
+    assert result.ok
+    assert json.loads(probe.read_text()) is None
+
+
+# ----------------------------------------------------------- query surfaces
+
+
+def test_runs_cli_summarizes_ledger(tmp_path):
+    led = tmp_path / "ledger.jsonl"
+    recs = [
+        {"t": "ledger", "event": "started", "ts": 1.0, "run_id": "r1",
+         "attempt": 1, "kind": "simulator", "config_fingerprint": "fp1"},
+        {"t": "ledger", "event": "finished", "ts": 2.0, "run_id": "r1",
+         "attempt": 1, "kind": "simulator", "wall_s": 1.0,
+         "metrics": {"rounds_per_sec": 3.0}},
+        {"t": "ledger", "event": "started", "ts": 3.0, "run_id": "r2",
+         "attempt": 1, "kind": "bench", "config_fingerprint": "fp2"},
+    ]
+    led.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "runs.py"),
+         "--ledger", str(led)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1  # the one-JSON-line contract
+    payload = json.loads(lines[0])
+    assert payload["ok"] and payload["runs"] == 2
+    assert payload["by_kind"] == {"simulator": 1, "bench": 1}
+    assert payload["by_outcome"] == {"finished": 1, "open": 1}
+    assert payload["distinct_configs"] == 2
+    latest = {r["run_id"]: r for r in payload["latest"]}
+    assert latest["r1"]["rounds_per_sec"] == 3.0
+    # --run-id trail
+    trail = json.loads(runs_cli_capture(["--ledger", str(led),
+                                         "--run-id", "r1"]))
+    assert trail["found"] and len(trail["attempts"]) == 1
+    assert trail["attempts"][0]["outcome"] == "finished"
+
+
+def runs_cli_capture(argv):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "runs.py"), *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout.strip().splitlines()[-1]
+
+
+def test_runs_cli_tunnel_windows(tmp_path):
+    import runs as runs_cli
+
+    t0 = 1000.0
+    probes = (
+        [{"t": "tunnel_probe", "ts": t0 + i * 60, "up": False}
+         for i in range(3)]
+        + [{"t": "tunnel_probe", "ts": t0 + 180 + i * 60, "up": True}
+           for i in range(2)]
+        + [{"t": "tunnel_probe", "ts": t0 + 300, "up": False}]
+    )
+    summary = runs_cli.summarize_tunnel(probes)
+    assert summary["probes"] == 6 and summary["up_probes"] == 2
+    assert summary["up_windows"] == 1 and summary["down_windows"] == 2
+    # each inter-probe interval belongs to the state its STARTING probe
+    # observed, so windows tile the full observed span: down owns
+    # [0, 180), up owns [180, 300), the final down probe is a point
+    assert summary["longest_up_s"] == 120.0
+    assert summary["longest_down_s"] == 180.0
+    assert summary["observed_s"] == 300.0
+    assert summary["up_time_frac"] == 0.4
+    assert summary["last_up"] is False
+    assert runs_cli.summarize_tunnel([]) == {"probes": 0}
+    # an alternating flaky log must still attribute every interval
+    flaky = [{"t": "tunnel_probe", "ts": t0 + i * 60, "up": bool(i % 2)}
+             for i in range(5)]
+    s = runs_cli.summarize_tunnel(flaky)
+    assert s["observed_s"] == 240.0
+    assert s["up_time_frac"] == 0.5 and s["longest_down_s"] == 60.0
+
+
+def test_runs_cli_missing_probe_log_is_empty_not_error():
+    """Review finding: no probe log is a valid observation (the vigil has
+    not run yet) — the CLI degrades to an empty tunnel summary."""
+    line = json.loads(subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "runs.py"),
+         "--tunnel", "/nonexistent/probes.jsonl"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    ).stdout.strip())
+    assert line["ok"] is True and line["tunnel"] == {"probes": 0}
+
+
+def test_runs_cli_error_is_one_json_line(monkeypatch, capsys):
+    """A bug in the query itself still reaches the driver as one
+    parseable error line (the JSON001 catch-all)."""
+    import runs as runs_cli
+
+    monkeypatch.setattr(
+        runs_cli, "summarize_runs",
+        lambda records: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    assert runs_cli.main(["--ledger", "/nonexistent/ledger.jsonl"]) == 1
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    payload = json.loads(lines[0])
+    assert payload["ok"] is False and "boom" in payload["error"]
+
+
+def test_tpu_capture_probe_record(tmp_path, monkeypatch):
+    """record_probe persists timestamped up/down evidence and never
+    raises, even against an unwritable destination."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import tpu_capture
+
+    dest = str(tmp_path / "probes.jsonl")
+    monkeypatch.setattr(tpu_capture, "PROBES", dest)
+    tpu_capture.record_probe(True, wall_s=1.5, source="watch")
+    tpu_capture.record_probe(False, source="capture")
+    recs = _records(dest)
+    assert [r["up"] for r in recs] == [True, False]
+    assert recs[0]["t"] == "tunnel_probe" and recs[0]["wall_s"] == 1.5
+    assert recs[0]["source"] == "watch"
+    monkeypatch.setattr(tpu_capture, "PROBES", "/nonexistent/dir/p.jsonl")
+    tpu_capture.record_probe(True)  # must not raise
+
+
+def test_perf_report_ingests_ledger_rows(tmp_path):
+    import perf_report
+
+    results = tmp_path / "results"
+    results.mkdir()
+    recs = [
+        {"t": "ledger", "event": "started", "ts": 1.0, "run_id": "rid-1",
+         "attempt": 1, "kind": "bench", "config_fingerprint": "fp",
+         "code_version": "a" * 40},
+        {"t": "ledger", "event": "finished", "ts": 2.0, "run_id": "rid-1",
+         "attempt": 1, "kind": "bench",
+         "metrics": {"rounds_per_sec": 7.5}},
+        # a run without throughput metrics contributes no row
+        {"t": "ledger", "event": "started", "ts": 3.0, "run_id": "rid-2",
+         "attempt": 1, "kind": "chaos"},
+    ]
+    (results / "ledger.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in recs) + "\n"
+    )
+    rows = perf_report.ingest_ledger(str(tmp_path))
+    assert len(rows) == 1
+    (row,) = rows
+    assert row["name"] == "ledger/bench/rid-1"
+    assert row["run_id"] == "rid-1" and row["rounds_per_sec"] == 7.5
+    assert row["config"] == "fp" and row["code_version"] == "a" * 12
+
+
+def test_trace_summary_compare_refuses_fingerprint_mismatch(tmp_path,
+                                                            capsys):
+    import trace_summary
+
+    def mk(path, rid, fp):
+        recs = [
+            {"t": "meta", "ts": 1.0, "pid": 1, "run_id": rid, "attempt": 1,
+             "config_fingerprint": fp},
+            {"t": "round", "round": 0, "wall_s": 0.1, "counters": {},
+             "gauges": {}, "run_id": rid, "attempt": 1},
+        ]
+        with open(path, "w") as f:
+            f.write("\n".join(json.dumps(r) for r in recs))
+
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    mk(a, "ra", "fp-a")
+    mk(b, "rb", "fp-b")
+    assert trace_summary.main(["--compare", a, b]) == 2
+    assert "REFUSING" in capsys.readouterr().err
+    assert trace_summary.main(["--compare", "--force", a, b]) == 0
+    captured = capsys.readouterr()
+    assert "WARNING" in captured.err
+    assert "run_id ra" in captured.out and "run_id rb" in captured.out
+    # same fingerprint: clean compare, no warning
+    c = str(tmp_path / "c.jsonl")
+    mk(c, "rc", "fp-a")
+    assert trace_summary.main(["--compare", a, c]) == 0
+    assert "WARNING" not in capsys.readouterr().err
+
+
+# -------------------------------------------------- simulator acceptance
+
+
+@pytest.fixture(scope="module")
+def healthy_run(tmp_path_factory):
+    """ONE tiny healthy Simulator run shared by the acceptance asserts:
+    ledger pair, envelope on every trace record, alert silence."""
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import Synthetic
+
+    tmp = tmp_path_factory.mktemp("run_identity")
+    led = str(tmp / "ledger.jsonl")
+    old = os.environ.get(ledger.LEDGER_ENV)
+    os.environ[ledger.LEDGER_ENV] = led
+    try:
+        ds = Synthetic(num_clients=6, train_size=240, test_size=60,
+                       cache=False)
+        log = str(tmp / "out")
+        sim = Simulator(ds, log_path=log, seed=0, aggregator="mean")
+        sim.run("mlp", global_rounds=3, local_steps=1, train_batch_size=8,
+                validate_interval=3, round_metrics=True)
+    finally:
+        if old is None:
+            os.environ.pop(ledger.LEDGER_ENV, None)
+        else:
+            os.environ[ledger.LEDGER_ENV] = old
+    return {
+        "ledger": ledger.read_ledger(led),
+        "trace": _records(os.path.join(log, "telemetry.jsonl")),
+    }
+
+
+def test_simulator_run_writes_ledger_pair(healthy_run):
+    recs = healthy_run["ledger"]
+    assert [r["event"] for r in recs] == ["started", "finished"]
+    started, finished = recs
+    assert started["kind"] == "simulator"
+    assert started["run_id"] == finished["run_id"]
+    assert started["config_fingerprint"]
+    assert started["config"]["num_clients"] == 6
+    assert started["env"].get("jax")  # env fingerprint saw the live jax
+    assert started["env"].get("n_devices") == 8  # conftest virtual mesh
+    assert started["code_version"] == ledger.code_version()
+    assert any("telemetry.jsonl" in a for a in started["artifacts"])
+    assert finished["metrics"]["rounds_completed"] == 3
+    assert finished["metrics"]["rounds_per_sec"] > 0
+
+
+def test_simulator_trace_carries_envelope_on_every_record(healthy_run):
+    trace = healthy_run["trace"]
+    rid = healthy_run["ledger"][0]["run_id"]
+    assert len(trace) > 10
+    meta = trace[0]
+    assert meta["t"] == "meta" and meta["run_id"] == rid
+    assert meta["config_fingerprint"] == (
+        healthy_run["ledger"][0]["config_fingerprint"]
+    )
+    for r in trace:
+        assert r.get("run_id") == rid and r.get("attempt") == 1, r
+
+
+def test_healthy_run_raises_zero_alerts(healthy_run):
+    trace = healthy_run["trace"]
+    assert [r for r in trace if r["t"] == "alert"] == []
+    # offline replay over the same records agrees
+    assert alerts.evaluate_records(trace) == []
+
+
+def test_interrupted_run_ledgers_killed_not_finished(tmp_path, monkeypatch):
+    """Review finding: a BaseException exit (Ctrl-C on a hung compile,
+    SupervisorTermination) bypasses the `except Exception` crash path —
+    the finally block must record it as `killed`, never `finished`."""
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import Synthetic
+
+    led = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv(ledger.LEDGER_ENV, led)
+
+    def interrupt(rnd, state, m):
+        raise KeyboardInterrupt
+
+    ds = Synthetic(num_clients=4, train_size=120, test_size=40, cache=False)
+    sim = Simulator(ds, log_path=str(tmp_path / "out"), seed=0,
+                    aggregator="mean")
+    with pytest.raises(KeyboardInterrupt):
+        sim.run("mlp", global_rounds=3, local_steps=1, train_batch_size=8,
+                validate_interval=5, on_round_end=interrupt)
+    recs = ledger.read_ledger(led)
+    assert [r["event"] for r in recs] == ["started", "killed"]
+    assert "KeyboardInterrupt" in recs[1]["error"]
+
+
+def test_telemetry_disabled_is_complete_noop(tmp_path, monkeypatch):
+    """BLADES_TELEMETRY=0: no trace, no alert engine — and the run still
+    completes. (The ledger has its own independent BLADES_LEDGER=0.)"""
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import Synthetic
+
+    monkeypatch.setenv("BLADES_TELEMETRY", "0")
+    monkeypatch.setenv(ledger.LEDGER_ENV, "0")
+    ds = Synthetic(num_clients=4, train_size=120, test_size=40, cache=False)
+    log = str(tmp_path / "out")
+    sim = Simulator(ds, log_path=log, seed=0, aggregator="mean")
+    sim.run("mlp", global_rounds=1, local_steps=1, train_batch_size=8,
+            validate_interval=5)
+    assert not os.path.exists(os.path.join(log, "telemetry.jsonl"))
+    assert sim.alert_engine is None
