@@ -169,6 +169,15 @@ def child_main() -> None:
     # dominates at dispatch-bound configs (small model, small K). 1 =
     # headline per-round path.
     block = max(1, int(os.environ.get("BENCH_BLOCK", 1)))
+    # buffered-async rounds (blades_tpu/asyncfl): BENCH_ASYNC=1 switches
+    # the engine to FedBuff-style semantics — seeded arrival process,
+    # first-BENCH_BUFFER_M fire threshold, BENCH_STALENESS weighting.
+    # Async rows are never the headline (a tick that does not fire is not
+    # a sync round's worth of work); the parent labels them _asyncM<m>.
+    async_on = os.environ.get("BENCH_ASYNC", "0") == "1"
+    buffer_m = int(os.environ.get("BENCH_BUFFER_M", max(1, k // 2)))
+    staleness = os.environ.get("BENCH_STALENESS", "polynomial")
+    async_max_delay = int(os.environ.get("BENCH_ASYNC_MAX_DELAY", 2))
 
     stage = "import"
     try:
@@ -241,6 +250,21 @@ def child_main() -> None:
         agg, agg_kwargs = _make_agg(
             get_aggregator, agg_name, num_byz, bool(num_byz_env)
         )
+        async_config = None
+        if async_on:
+            from blades_tpu.asyncfl import ArrivalProcess, AsyncConfig
+
+            async_config = AsyncConfig(
+                buffer_m=buffer_m,
+                arrivals=ArrivalProcess(
+                    kind="uniform", max_delay=async_max_delay
+                ),
+                staleness=staleness,
+                alpha=0.5,
+                cutoff=(
+                    2 * async_max_delay if staleness == "cutoff" else None
+                ),
+            )
         devices = jax.devices()
         plan = make_plan(make_mesh(devices)) if len(devices) > 1 else None
         if plan is not None:
@@ -270,6 +294,7 @@ def child_main() -> None:
             # to donate (~0.4 GB HBM back at the K=1000 headline)
             donate_batches=os.environ.get("BENCH_DONATE_BATCHES", "1") == "1",
             streaming=streaming,
+            async_config=async_config,
         )
         state = engine.init(params)
         key = jax.random.PRNGKey(7)
@@ -331,6 +356,14 @@ def child_main() -> None:
             from blades_tpu.telemetry.profiling import start_capture
 
             profiled = start_capture(profile_dir, telem)
+        # async accounting: the cumulative fire counter rides the state
+        # (one host read before/after the window — no per-round sync), and
+        # per-launch diags are collected as DEVICE references during the
+        # loop, converted only after the timed region closes
+        fires_before = (
+            int(state.async_state["fires"]) if async_on else 0
+        )
+        async_diags = []
         t0 = time.time()
         launches = 0
         r = warmup_rounds
@@ -342,6 +375,8 @@ def child_main() -> None:
                 state, m = one_round(state, r)
                 r += 1
             launches += 1
+            if async_on:
+                async_diags.append(engine.last_async_diag)
         jax.block_until_ready(state.params)
         elapsed = time.time() - t0
         if profiled:
@@ -353,6 +388,27 @@ def child_main() -> None:
         loss = float(m.train_loss if block == 1 else m.train_loss[-1])
         if not np.isfinite(loss):
             raise RuntimeError(f"non-finite loss {loss}")
+
+        # async payload fields: fires per tick from the cumulative state
+        # counter (exact over the timed window), mean staleness averaged
+        # over the collected per-launch diags' FIRED entries (block mode
+        # samples each block's final round — documented sampling, never a
+        # fabricated number)
+        agg_fires_per_round = None
+        mean_staleness = None
+        if async_on:
+            fires_after = int(state.async_state["fires"])
+            agg_fires_per_round = round(
+                (fires_after - fires_before) / max(timed_rounds, 1), 4
+            )
+            taus = [
+                float(d["mean_staleness"])
+                for d in async_diags
+                if int(d["fired"])
+            ]
+            mean_staleness = (
+                round(sum(taus) / len(taus), 4) if taus else 0.0
+            )
 
         # snapshot compile/cache counters BEFORE the agg probe below: its
         # own jit compile is not part of the round program's cold-start
@@ -482,6 +538,14 @@ def child_main() -> None:
                     "block_size": block,
                     "rounds_per_launch": timed / launches,
                     "launches": launches,
+                    # buffered-async semantics (blades_tpu/asyncfl): the
+                    # effective fire threshold + measured fire cadence and
+                    # staleness — absent (null) on sync runs
+                    "async": async_on,
+                    "buffer_m": engine.async_buffer_m if async_on else None,
+                    "staleness": staleness if async_on else None,
+                    "agg_fires_per_round": agg_fires_per_round,
+                    "mean_staleness": mean_staleness,
                     "model": model_name,
                     "agg": agg_name,
                     "agg_kwargs": agg_kwargs,
@@ -573,6 +637,9 @@ def _ladder_main() -> None:
         "block": os.environ.get("BENCH_BLOCK", "1"),
         "streaming": os.environ.get("BENCH_STREAMING", "0"),
         "bf16": os.environ.get("BENCH_BF16", "1"),
+        "async": os.environ.get("BENCH_ASYNC", "0"),
+        "buffer_m": os.environ.get("BENCH_BUFFER_M", ""),
+        "staleness_mode": os.environ.get("BENCH_STALENESS", ""),
     }
     ledger_entry = _ledger.run_started("bench", config=bench_config)
 
@@ -709,6 +776,15 @@ def _ladder_main() -> None:
                   "peak_update_bytes"):
         if result.get(field) is not None:
             payload[field] = result[field]
+    # buffered-async fields (null-stripped): fire threshold + measured
+    # fire cadence and staleness, so async rows are self-describing in
+    # the perf trajectory (scripts/perf_report.py)
+    if result.get("async"):
+        payload["async"] = True
+        for field in ("buffer_m", "staleness", "agg_fires_per_round",
+                      "mean_staleness"):
+            if result.get(field) is not None:
+                payload[field] = result[field]
     nondefault_model = result.get("model", "cct_2_3x2_32") != "cct_2_3x2_32"
     nondefault_agg = result.get("agg", "trimmedmean") != "trimmedmean"
     # any attacked / Adam-client / multi-step variant is not the headline
@@ -723,6 +799,10 @@ def _ladder_main() -> None:
         # the streaming client axis trades per-round speed for K-scaling;
         # its rows are memory evidence, never the headline
         or bool(result.get("streaming"))
+        # a buffered-async tick that does not fire is not a sync round's
+        # worth of work — async throughput rows are a separate (labeled)
+        # trajectory, never the headline
+        or bool(result.get("async"))
     )
     if (
         result["clients"] != full_k
@@ -751,6 +831,8 @@ def _ladder_main() -> None:
                 payload["config"] += f"_blk{result['block_size']}"
             if result.get("streaming"):
                 payload["config"] += f"_stream{result.get('client_chunks')}"
+            if result.get("async"):
+                payload["config"] += f"_asyncM{result.get('buffer_m')}"
             payload["vs_baseline"] = None
     if errors:
         payload["attempt_errors"] = "; ".join(errors)[:500]

@@ -63,6 +63,8 @@ _LAZY = {
     "ServerOptSpec": ("blades_tpu.core", "ServerOptSpec"),
     "FaultModel": ("blades_tpu.faults", "FaultModel"),
     "AuditMonitor": ("blades_tpu.audit", "AuditMonitor"),
+    "AsyncConfig": ("blades_tpu.asyncfl", "AsyncConfig"),
+    "ArrivalProcess": ("blades_tpu.asyncfl", "ArrivalProcess"),
 }
 
 

@@ -161,14 +161,26 @@ class AnchorClipping(DecentralizedMixing):
 
 
 class Asyncmean(Aggregator):
-    """Async mean (reference ``_AsyncMean``): absent workers contribute zero
-    but stay in the denominator — ``sum(present updates) / K``.
+    """Async mean (reference ``_AsyncMean``,
+    ``src/blades/aggregators/mean.py:42-76``): absent workers contribute
+    zero but stay in the denominator — ``sum(present updates) / K``.
 
-    Reachability note: the synchronous round engine trains every client each
-    round and passes no ``present`` mask, under which this degenerates to
-    plain mean — exactly as the reference's async classes are unreachable
-    from its Simulator. Drive directly (``agg(updates, present=...)``) for
-    straggler simulations.
+    Under the buffered-asynchronous engine (``blades_tpu/asyncfl``) this
+    is the **constant-staleness-weighted FedBuff server mean with 1/K
+    damping**: each fire aggregates the buffered arrivals through
+    :meth:`_masked_aggregate` (the participation mask IS the buffer
+    occupancy), staleness weighting ``"constant"`` leaves every buffered
+    row at weight 1, and the fixed-K denominator damps the applied step by
+    ``n_buffered / K`` — the deliberate under-step of the asynchronous
+    setting (a fire fed by few arrivals moves the model proportionally
+    less). ``buffer_m = K`` + zero delays recovers plain ``Mean``
+    numerically (``sum(u)/K`` vs ``mean(u)`` trace different XLA
+    reductions; the BIT-exact contract is async-asyncmean == sync-
+    asyncmean, the registry-wide degenerate-equivalence invariant), and
+    ``buffer_m < K`` steps are damped by exactly ``n/K`` — both pinned by
+    ``tests/test_asyncfl.py``. The reference's class is unreachable dead
+    code from its synchronous Simulator; here the registry entry names the
+    semantics the async engine actually executes.
     """
 
     # certification opt-out (blades_tpu.audit): an (async) mean — breakdown

@@ -2,9 +2,10 @@
 
 The Tier-A lints catch source patterns; this auditor catches what only
 the lowered program can prove. It builds the real
-:class:`~blades_tpu.core.RoundEngine` round / round-block / streaming
-programs for a tiny MLP config (the ``dryrun_multichip`` recipe:
-production program shape, toy D) and asserts, per program:
+:class:`~blades_tpu.core.RoundEngine` round / round-block / streaming /
+buffered-async (``blades_tpu/asyncfl``) programs for a tiny MLP config
+(the ``dryrun_multichip`` recipe: production program shape, toy D) and
+asserts, per program:
 
 - **donation** — the state argument's donation is actually honored by the
   backend: the compiled HLO carries an ``input_output_alias`` map (and
@@ -48,9 +49,16 @@ _BLOCK_ROUNDS = 2
 _CHUNKS = 2
 
 
-def _build_engine(plan=None, streaming: bool = False, client_chunks: int = 1):
+def _build_engine(
+    plan=None, streaming: bool = False, client_chunks: int = 1,
+    use_async: bool = False,
+):
     """A tiny-MLP RoundEngine wired exactly like production (trimmed-mean
-    defense, sign-flip attack, donated state, matrix kept in-graph)."""
+    defense, sign-flip attack, donated state, matrix kept in-graph).
+    ``use_async=True`` builds the buffered-async body instead (lagging
+    arrival process + polynomial staleness, so the version ring, the
+    per-client gather and the weighting multiply are all in the audited
+    program)."""
     import jax
 
     from blades_tpu.aggregators import get_aggregator
@@ -59,6 +67,16 @@ def _build_engine(plan=None, streaming: bool = False, client_chunks: int = 1):
     from blades_tpu.models.common import build_fns
     from blades_tpu.models.mlp import MLP
 
+    async_config = None
+    if use_async:
+        from blades_tpu.asyncfl import ArrivalProcess, AsyncConfig
+
+        async_config = AsyncConfig(
+            buffer_m=_K // 2,
+            arrivals=ArrivalProcess(kind="uniform", max_delay=1),
+            staleness="polynomial",
+            alpha=0.5,
+        )
     spec = build_fns(MLP(num_classes=10, hidden=(8,)), sample_shape=(28, 28, 1))
     params = spec.init(jax.random.PRNGKey(0))
     engine = RoundEngine(
@@ -76,6 +94,7 @@ def _build_engine(plan=None, streaming: bool = False, client_chunks: int = 1):
         streaming=streaming,
         client_chunks=client_chunks,
         keep_updates=False,
+        async_config=async_config,
     )
     return engine, params
 
@@ -383,6 +402,40 @@ def run_tier_b(force_platform: bool = False) -> Dict[str, Any]:
 
     checks.append(
         check_retrace_stability("streaming", streaming_twice, st_engine._round_jit)
+    )
+
+    # -- buffered-async round: donation + dtype + retrace + axis ---------------
+    # (blades_tpu/asyncfl — the version ring, per-client lag gather,
+    # buffer/fire wheres and the staleness multiply are all new jitted
+    # surface; the same four invariants gate it)
+    a_engine, a_params = _build_engine(use_async=True)
+    a_state, a_cx, a_cy = _round_args(a_engine, a_params)
+    compiled = a_engine._round_jit.lower(
+        a_state, a_cx, a_cy, lr, lr, key
+    ).compile()
+    checks.append(check_donation("async", compiled))
+    checks.append(check_no_f64("async", compiled))
+    # axis check on the SHARDED async body (trace-only, no compile): the
+    # buffer matrix and the lagged-params gather are rank-2 [K, D] values
+    # under the same clients-only constraint rule as the update matrix
+    sa_engine, sa_params = _build_engine(plan=plan, use_async=True)
+    sa_state, sa_cx, sa_cy = _round_args(sa_engine, sa_params, plan=plan)
+    closed = jax.make_jaxpr(sa_engine._round)(
+        sa_state, sa_cx, sa_cy, lr, lr, key
+    )
+    res = check_sharding_axis("async_sharded", closed)
+    res["detail"] += f" [mesh {mesh_shape}]"
+    checks.append(res)
+
+    def async_twice():
+        st, cx2, cy2 = _round_args(a_engine, a_params)
+        st, _ = a_engine.run_round(st, cx2, cy2, 0.1, 1.0, key)
+        yield jax.block_until_ready(st.params)
+        st, _ = a_engine.run_round(st, cx2, cy2, 0.1, 1.0, key)
+        yield jax.block_until_ready(st.params)
+
+    checks.append(
+        check_retrace_stability("async", async_twice, a_engine._round_jit)
     )
 
     violations = [c for c in checks if not c["ok"]]

@@ -56,6 +56,9 @@ DEVICE_SCOPES = (
     "blades_tpu/aggregators",
     "blades_tpu/faults",
     "blades_tpu/audit",
+    # buffered-async round body + arrival/staleness primitives — jitted
+    # surface exactly like core/engine.py (PR 10)
+    "blades_tpu/asyncfl",
 )
 
 _JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
@@ -91,6 +94,12 @@ PROTOCOL_ROOTS = {
     "apply",
     "corrupt_chunk",
     "plan_streaming",
+    # asyncfl surface traced by the engine's _round dispatch
+    # (blades_tpu/asyncfl/engine.py) and the in-body arrival/staleness
+    # draws (arrivals.py / buffer.py)
+    "async_round",
+    "draw",
+    "staleness_mask_weights",
 }
 
 _BANNED_CALLS = {

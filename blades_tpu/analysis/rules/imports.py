@@ -60,6 +60,9 @@ JAX_IMPORTING_MODULES = (
     "blades_tpu.simulator",
     "blades_tpu.utils.platform",
     "blades_tpu.analysis.program_audit",
+    # the buffered-async subsystem imports jax at module scope (its whole
+    # surface is jitted round-body code, PR 10)
+    "blades_tpu.asyncfl",
 )
 
 

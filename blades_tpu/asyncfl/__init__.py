@@ -1,0 +1,25 @@
+"""Buffered-asynchronous federated rounds (FedBuff-style), in-graph.
+
+The engine's second round semantics: clients arrive on a seeded,
+fixed-shape schedule (``arrivals.py``), the server buffers the first-M
+arrivals and aggregates them with pluggable staleness weighting
+(``buffer.py``), and the whole tick — version-lagged training, deposit,
+fire, staleness-weighted robust aggregation, audited server step — is one
+jitted XLA program (``engine.py``) dispatched by
+:class:`blades_tpu.core.RoundEngine` when built with ``async_config=``
+(:class:`Simulator.run(async_config=...) <blades_tpu.Simulator>` threads
+it through). Degenerate configuration (``buffer_m=K``, zero delays,
+constant weighting) is bit-identical to the synchronous round across the
+full aggregator registry (``tests/test_asyncfl.py``).
+
+Reference counterpart: none — the reference simulator is strictly
+synchronous (``src/blades/simulator.py:203-247``); its unreachable
+``_BaseAsyncAggregator`` family (``src/blades/aggregators/mean.py:42-87``)
+gets real arrival/buffer/staleness semantics here. Protocol: FedBuff
+(Nguyen et al., AISTATS 2022).
+"""
+
+from blades_tpu.asyncfl.arrivals import ArrivalProcess
+from blades_tpu.asyncfl.buffer import STALENESS_MODES, AsyncConfig
+
+__all__ = ["ArrivalProcess", "AsyncConfig", "STALENESS_MODES"]

@@ -1,0 +1,161 @@
+"""Seeded, fixed-shape client arrival process for buffered-async rounds.
+
+Production federated clients do not report in lockstep: each client
+downloads the current global model, trains for a device-dependent amount
+of wall time, and reports whenever it finishes (FedBuff, Nguyen et al.,
+AISTATS 2022). This module models that timing as a **fixed-shape, seeded
+process inside the jitted round program**: every client carries an integer
+``countdown`` (server rounds until its in-flight update arrives); a client
+whose countdown hits zero *arrives* this round, deposits its update into
+the server buffer (``blades_tpu/asyncfl/buffer.py``), immediately
+re-downloads the current model, and draws a fresh delay from the dedicated
+``rng.ARRIVAL`` stream — all masks and ``where``\\s, no data-dependent
+shapes, exactly the discipline of the fault layer
+(``blades_tpu/faults/model.py``).
+
+Delay distributions (``kind``):
+
+- ``"zero"`` — every delay is 0: clients arrive every round (the
+  degenerate sync-equivalent process; with ``buffer_m == K`` and constant
+  staleness weighting the buffered round is bit-identical to the sync
+  round, ``tests/test_asyncfl.py``);
+- ``"fixed"`` — a static per-client delay vector (deterministic
+  heterogeneity: fast phones vs slow phones);
+- ``"uniform"`` — i.i.d. integer delays uniform on
+  ``[min_delay, max_delay]`` per (client, cycle);
+- ``"geometric"`` — geometric-ish delays with mean ``mean_delay``,
+  clipped to ``max_delay`` (the long-tail straggler shape).
+
+Every draw is a pure function of ``(seed, round, client)`` via
+``fold_in(fold_in(round_key, rng.ARRIVAL), client)``, so any round's
+arrival pattern is reproducible in isolation and a resumed run replays the
+exact arrival history (the bit-exact resume contract).
+
+Reference counterpart: none — the reference simulator is strictly
+synchronous (``src/blades/simulator.py:203-247`` trains every client every
+round and blocks on all of them); its async aggregator classes
+(``_BaseAsyncAggregator``, ``mean.py:42-60``) are unreachable dead code
+with no arrival semantics at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from blades_tpu.utils import rng
+
+_KINDS = ("zero", "fixed", "uniform", "geometric")
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrivalProcess:
+    """Per-client delay distribution -> integer arrival-round offsets.
+
+    Construction-time hyperparameters are static under jit (the process
+    object rides on the engine like a :class:`~blades_tpu.faults.FaultModel`).
+
+    Parameters
+    ----------
+    kind : one of ``"zero" | "fixed" | "uniform" | "geometric"``.
+    max_delay : static upper bound on any delay draw (rounds). Also sizes
+        the engine's version-lagged parameter history (``max_delay + 1``
+        ring slots), so it is a memory knob: ``[max_delay + 1, D]`` floats.
+    min_delay : lower bound for ``"uniform"``.
+    mean_delay : mean for ``"geometric"``.
+    delays : static per-client delay vector for ``"fixed"`` (length K,
+        each entry clipped to ``[0, max_delay]``).
+    """
+
+    kind: str = "zero"
+    max_delay: int = 0
+    min_delay: int = 0
+    mean_delay: float = 1.0
+    delays: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown arrival kind {self.kind!r}; one of {_KINDS}")
+        if self.max_delay < 0:
+            raise ValueError(f"max_delay must be >= 0, got {self.max_delay}")
+        if self.kind == "zero" and self.max_delay != 0:
+            object.__setattr__(self, "max_delay", 0)
+        if self.kind == "fixed":
+            if self.delays is None:
+                raise ValueError("kind='fixed' needs a per-client `delays` vector")
+            d = tuple(int(x) for x in self.delays)
+            if any(x < 0 for x in d):
+                raise ValueError("fixed delays must be >= 0")
+            object.__setattr__(self, "delays", d)
+            object.__setattr__(
+                self, "max_delay", max(self.max_delay, max(d, default=0))
+            )
+        if not (0 <= self.min_delay <= self.max_delay) and self.kind == "uniform":
+            raise ValueError(
+                f"uniform needs 0 <= min_delay <= max_delay, got "
+                f"[{self.min_delay}, {self.max_delay}]"
+            )
+
+    # -- the in-graph draw -----------------------------------------------------
+
+    def draw(self, round_key: jax.Array, num_clients: int) -> jnp.ndarray:
+        """``[K]`` int32 delay draws for clients (re)downloading this
+        round — consumed only at entries where the arrival mask is True,
+        but drawn fixed-shape for every client so the program never
+        branches on data. Pure function of ``(round_key, client)`` through
+        the dedicated ``rng.ARRIVAL`` stream."""
+        k = int(num_clients)
+        if self.kind == "zero":
+            return jnp.zeros((k,), jnp.int32)
+        if self.kind == "fixed":
+            if len(self.delays) != k:
+                raise ValueError(
+                    f"fixed delays length {len(self.delays)} != "
+                    f"num_clients {k}"
+                )
+            # static table (already validated/clipped in __post_init__)
+            return jnp.asarray(self.delays, jnp.int32)
+        akey = jax.random.fold_in(round_key, rng.ARRIVAL)
+        keys = jax.vmap(lambda i: jax.random.fold_in(akey, i))(jnp.arange(k))
+        if self.kind == "uniform":
+            return jax.vmap(
+                lambda kk: jax.random.randint(
+                    kk, (), self.min_delay, self.max_delay + 1, jnp.int32
+                )
+            )(keys)
+        # geometric: floor(log(u) / log(1 - p)) with p = 1 / (1 + mean),
+        # clipped into [0, max_delay] — the standard inverse-CDF draw,
+        # fixed-shape and branch-free
+        p = 1.0 / (1.0 + float(self.mean_delay))
+        u = jax.vmap(
+            lambda kk: jax.random.uniform(
+                kk, (), jnp.float32, 1e-7, 1.0
+            )
+        )(keys)
+        g = jnp.floor(jnp.log(u) / jnp.log1p(-p)).astype(jnp.int32)
+        return jnp.clip(g, 0, self.max_delay)
+
+    @property
+    def history_len(self) -> int:
+        """Ring-buffer depth of the version-lagged parameter history the
+        engine must carry: a client arriving with delay ``d <= max_delay``
+        trains against the model published ``d`` rounds ago, so
+        ``max_delay + 1`` slots always cover the gather."""
+        return int(self.max_delay) + 1
+
+    def __repr__(self) -> str:
+        if self.kind == "zero":
+            return "ArrivalProcess(zero)"
+        if self.kind == "fixed":
+            return f"ArrivalProcess(fixed, max={self.max_delay})"
+        if self.kind == "uniform":
+            return (
+                f"ArrivalProcess(uniform[{self.min_delay},{self.max_delay}])"
+            )
+        return (
+            f"ArrivalProcess(geometric(mean={self.mean_delay}, "
+            f"max={self.max_delay}))"
+        )

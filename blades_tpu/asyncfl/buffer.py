@@ -1,0 +1,188 @@
+"""FedBuff-style bounded server buffer + pluggable staleness weighting.
+
+The buffered-asynchronous server (FedBuff, Nguyen et al., AISTATS 2022)
+does not wait for all K clients: arriving updates accumulate in a buffer,
+and when the **first-M threshold** is met the server aggregates the
+buffered set — each update weighted by a function of its *staleness*
+``tau`` (server rounds since that client downloaded the model it trained
+against) — applies the step, and drains the buffer.
+
+TPU-native design decisions (all fixed-shape, all carried in
+``RoundState.async_state`` so crash-autosave/resume is bit-exact with a
+non-empty buffer):
+
+- **per-client buffer slots** — a client has at most one update in flight
+  (it re-downloads only when it arrives), so the bounded buffer is a
+  ``[K, D]`` matrix + ``[K]`` occupancy mask indexed by client id; a
+  round-granular simulation can deposit several arrivals at once, and the
+  fire drains the whole buffer (first-M is the *trigger*, not an exact
+  take-M — documented round-granularity semantics);
+- **staleness weighting as a mask-compatible per-row weight** — weights
+  are **normalized to mean 1 over the aggregated set**
+  (``w_i * n / sum(w)``), applied by scaling rows before the registry's
+  mask-aware aggregation. Every registered aggregator therefore composes
+  unchanged through ``Aggregator.aggregate_masked``; for the mean family
+  the estimator is exactly FedBuff's weighted mean
+  ``sum(w_i d_i) / sum(w_i)``, robust defenses see a soft staleness
+  discount that leaves the honest scale invariant, and **constant
+  weighting is the literal identity** (no multiply is traced), which is
+  what makes the degenerate sync-equivalence bit-exact;
+- **version-lagged training** — arriving clients trained against the
+  model *version they downloaded*; the engine carries a
+  ``[max_delay + 1, D]`` ring of published flat params
+  (``blades_tpu/asyncfl/engine.py``) and gathers per-client rows by
+  version, statically skipped when ``max_delay == 0``.
+
+Weighting modes (``staleness``): ``"constant"`` (w = 1 — the semantics
+the registry's ``asyncmean`` entry names, ``aggregators/decentralized.py``),
+``"polynomial"`` (``w = 1 / (1 + tau)^alpha``, FedBuff's default shape),
+``"cutoff"`` (updates staler than ``cutoff`` rounds are *excluded from
+the participation mask* — weight-0 as exclusion, so masked-row inertness
+carries over).
+
+Reference counterpart: none — the reference has no buffer or staleness
+semantics; its ``_BaseAsyncAggregator`` family (``src/blades/aggregators/
+mean.py:42-87``) damps absent workers by 1/K but is unreachable from its
+synchronous simulator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+from blades_tpu.asyncfl.arrivals import ArrivalProcess
+
+STALENESS_MODES = ("constant", "polynomial", "cutoff")
+
+
+@dataclasses.dataclass(frozen=True)
+class AsyncConfig:
+    """Buffered-asynchronous round semantics for the engine.
+
+    Parameters
+    ----------
+    buffer_m : the first-M aggregation threshold — the server fires (and
+        steps) on any round whose buffer holds at least this many updates.
+        Clamped into ``[1, K]`` at engine build.
+    arrivals : the seeded :class:`~blades_tpu.asyncfl.arrivals.ArrivalProcess`
+        (or a kwargs dict for one).
+    staleness : ``"constant" | "polynomial" | "cutoff"`` (see module
+        docstring).
+    alpha : polynomial exponent (``w = (1 + tau)^-alpha``).
+    cutoff : staleness bound for ``"cutoff"`` (rounds; buffered updates
+        with ``tau > cutoff`` are excluded from aggregation).
+    """
+
+    buffer_m: int = 1
+    arrivals: Union[ArrivalProcess, Dict] = ArrivalProcess()
+    staleness: str = "constant"
+    alpha: float = 0.5
+    cutoff: Optional[int] = None
+
+    def __post_init__(self):
+        if isinstance(self.arrivals, dict):
+            object.__setattr__(self, "arrivals", ArrivalProcess(**self.arrivals))
+        if self.staleness not in STALENESS_MODES:
+            raise ValueError(
+                f"unknown staleness mode {self.staleness!r}; one of "
+                f"{STALENESS_MODES}"
+            )
+        if self.buffer_m < 1:
+            raise ValueError(f"buffer_m must be >= 1, got {self.buffer_m}")
+        if self.staleness == "cutoff":
+            if self.cutoff is None:
+                raise ValueError(
+                    "staleness='cutoff' needs an integer `cutoff`"
+                )
+            if int(self.cutoff) < 0:
+                # a negative bound would exclude even fresh (tau=0) rows —
+                # and the zero-delay static specialization (asyncfl/
+                # engine.py) is only a faithful shortcut when tau=0 rows
+                # are included
+                raise ValueError(
+                    f"cutoff must be >= 0, got {self.cutoff}"
+                )
+
+    # -- fixed-shape async state ----------------------------------------------
+
+    def init_state(self, num_clients: int, dim: int) -> Dict[str, Any]:
+        """Initial ``RoundState.async_state`` pytree. Everything a resumed
+        run needs to replay the async dynamics bit-exactly: the buffer +
+        occupancy, per-client download versions and arrival countdowns,
+        the cumulative fire counter, and (only when the process can lag)
+        the ``[max_delay + 1, D]`` published-params ring.
+
+        Countdown starts at 0 for every client — round 0 is a warm
+        synchronous start (every client downloaded version 0 and reports
+        immediately); the arrival process staggers them from round 1 on.
+        """
+        k, d = int(num_clients), int(dim)
+        state: Dict[str, Any] = {
+            "buf": jnp.zeros((k, d), jnp.float32),
+            "buf_mask": jnp.zeros((k,), bool),
+            # download version of the update sitting in each buffer slot
+            # (staleness base at fire time; the in-flight `version` below
+            # moves on when the client re-downloads)
+            "buf_version": jnp.zeros((k,), jnp.int32),
+            "version": jnp.zeros((k,), jnp.int32),
+            "countdown": jnp.zeros((k,), jnp.int32),
+            "fires": jnp.zeros((), jnp.int32),
+        }
+        if self.arrivals.max_delay > 0:
+            state["hist"] = jnp.zeros(
+                (self.arrivals.history_len, d), jnp.float32
+            )
+        return state
+
+    # -- staleness weighting ---------------------------------------------------
+
+    def staleness_mask_weights(
+        self, tau: jnp.ndarray, mask: jnp.ndarray
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """``(agg_mask, weights)`` for one fire: the participation mask
+        after the cutoff rule and the **mean-1-normalized** per-row
+        weights over it.
+
+        ``tau`` is the ``[K]`` int staleness vector (current round minus
+        download version; junk at masked-out entries), ``mask`` the
+        buffered-occupancy mask. Constant mode returns exact ones (the
+        caller statically skips the row multiply — bit-exact degenerate
+        equivalence); polynomial returns ``w_i * n / sum(w)`` so the
+        honest update scale is weighting-invariant; cutoff excludes stale
+        rows from the mask instead of down-weighting them (exclusion
+        composes with the registry's masked-row inertness contract).
+        """
+        mask = jnp.asarray(mask).astype(bool)
+        if self.staleness == "cutoff":
+            agg_mask = mask & (tau <= jnp.asarray(self.cutoff, tau.dtype))
+            return agg_mask, jnp.ones(tau.shape, jnp.float32)
+        if self.staleness == "constant":
+            return mask, jnp.ones(tau.shape, jnp.float32)
+        # polynomial: 1 / (1 + tau)^alpha, normalized to mean 1 over mask
+        raw = jnp.power(
+            1.0 + jnp.maximum(tau, 0).astype(jnp.float32), -float(self.alpha)
+        )
+        raw = jnp.where(mask, raw, 0.0)
+        n = jnp.sum(mask.astype(jnp.float32))
+        denom = jnp.maximum(jnp.sum(raw), 1e-12)
+        w = raw * (jnp.maximum(n, 1.0) / denom)
+        return mask, jnp.where(mask, w, 1.0)
+
+    @property
+    def weights_are_identity(self) -> bool:
+        """Static: True when no row multiply needs tracing (constant and
+        cutoff modes — cutoff acts through the mask)."""
+        return self.staleness in ("constant", "cutoff")
+
+    def __repr__(self) -> str:
+        parts = [f"m={self.buffer_m}", repr(self.arrivals)]
+        if self.staleness == "polynomial":
+            parts.append(f"poly(a={self.alpha})")
+        elif self.staleness == "cutoff":
+            parts.append(f"cutoff({self.cutoff})")
+        else:
+            parts.append("constant")
+        return f"AsyncConfig({', '.join(parts)})"

@@ -1,0 +1,376 @@
+"""The buffered-asynchronous round body: FedBuff semantics as ONE jitted
+fixed-shape program, sibling of the engine's dense/streaming bodies.
+
+``async_round(engine, ...)`` is traced by
+:meth:`blades_tpu.core.RoundEngine._round` whenever the engine was built
+with ``async_config=``; it returns the same output structure as the sync
+bodies (plus the async diagnostics slot), so ``run_round`` / ``run_block``
+— and therefore round-block scanning, crash-autosave, bit-exact resume,
+telemetry and the compile-count gates — ride unchanged.
+
+One server round (one tick of the async clock), all masks and ``where``\\s:
+
+1. **publish** — when the arrival process can lag (``max_delay > 0``),
+   write the current flat params into the ``[max_delay + 1, D]`` version
+   ring and gather each client's download version back out, so arriving
+   clients train against the model *they* downloaded (version-lagged
+   params as fixed-shape state). ``max_delay == 0`` statically skips the
+   ring and trains from the live params through the exact same code path
+   as the sync round;
+2. **train + attack + faults** — every client trains fixed-shape (the
+   non-arriving clients' work is masked out, the fault layer's discipline);
+   the attack's ``on_updates`` hook and the optional
+   :class:`~blades_tpu.faults.FaultModel` apply exactly as in the sync
+   body. A fault-dropped arrival is *lost* (the client re-downloads and
+   moves on) — dropout composes with arrival timing;
+3. **deposit** — arriving, delivered updates land in their client's buffer
+   slot (one slot per client: a client has at most one update in flight;
+   newest wins). The slot records the download version for staleness;
+4. **fire** — when the buffer holds >= ``buffer_m`` updates the server
+   aggregates the buffered set through the registry's mask-aware surface
+   (``Aggregator.aggregate_masked``) over rows scaled by the normalized
+   staleness weights (``asyncfl/buffer.py``), runs the
+   :class:`~blades_tpu.audit.AuditMonitor` certificates over those SAME
+   staleness-weighted rows, applies the (possibly fallback) aggregate as
+   the pseudo-gradient, and drains the buffer. Non-fired ticks leave
+   params, server-opt state and aggregator state bit-untouched (gated
+   ``where``\\s);
+5. **re-download** — arrived clients take the post-step model (version
+   ``t + 1``) and draw a fresh delay from the ``rng.ARRIVAL`` stream.
+
+**Static sync specialization** (the bit-exactness anchor): with zero-delay
+arrivals and no fault model, the schedule is *statically* synchronous —
+every client arrives every tick with staleness 0, the deposit mask is
+all-true by construction, the tick always fires, and every staleness mode
+weighs fresh rows at exactly 1. The body detects this at trace time and
+routes aggregation/audit/metrics through the **identical unmasked calls
+the sync body traces** (no mask selects, no gating ``where``\\s, no
+weight multiplies anywhere near the defense arithmetic), because XLA's
+fusion is free to contract a mathematically-identity masked expression
+(e.g. ``sum(u * mask) / n`` with FMA) 1 ulp away from the plain reduction
+— close is not the contract. ``buffer_m=K`` + zero delays + constant
+weighting is therefore bit-identical to the sync round across the full
+aggregator registry (``tests/test_asyncfl.py``), structurally rather than
+by compiler luck; any delay, fault model, or ``buffer_m`` that can leave
+a tick unfired exercises the general masked path.
+
+Reference counterpart: none — the reference simulator is strictly
+synchronous (``src/blades/simulator.py:203-247``); FedBuff semantics
+follow Nguyen et al. (AISTATS 2022), staleness weighting the polynomial
+family surveyed there and in the asynchronous-SGD robustness line
+(Zeno++ / BASGD).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from blades_tpu.ops.pytree import ravel
+from blades_tpu.telemetry.metric_pack import pack_dense
+from blades_tpu.utils import rng
+
+
+def _tree_where(pred, new: Any, old: Any) -> Any:
+    """Gate a whole pytree on a scalar bool (fired -> advanced state)."""
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), new, old
+    )
+
+
+def _rows_where(mask: jnp.ndarray, new: Any, old: Any) -> Any:
+    """Per-client gate along the leading K axis of every leaf."""
+
+    def pick(a, b):
+        m = mask.reshape((mask.shape[0],) + (1,) * (a.ndim - 1))
+        return jnp.where(m, a, b)
+
+    return jax.tree_util.tree_map(pick, new, old)
+
+
+def async_round(engine, state, cx, cy, client_lr, server_lr, key):
+    """One buffered-asynchronous server tick (see module docstring).
+
+    Output structure matches the sync bodies:
+    ``(new_state, metrics, updates-or-(), agg_diag, fault_diag,
+    audit_diag, metric_pack, async_diag)``.
+    """
+    from blades_tpu.core.engine import RoundMetrics, RoundState
+
+    cfg = engine.async_config
+    astate = state.async_state
+    k = engine.num_clients
+    t = state.round_idx
+    round_key = rng.key_for_round(key, t)
+    client_keys = rng.key_per_client(round_key, k)
+    attack_key = jax.random.fold_in(round_key, rng.ATTACK)
+    # statically-synchronous schedule: zero delays + no faults => every
+    # tick is a full arrival, a guaranteed fire, staleness 0, weight 1
+    # (see "Static sync specialization" in the module docstring)
+    static_sync = cfg.arrivals.kind == "zero" and engine.fault_model is None
+
+    if engine.plan is not None:
+        cx = lax.with_sharding_constraint(cx, engine.plan.clients)
+        cy = lax.with_sharding_constraint(cy, engine.plan.clients)
+
+    # -- 1. publish the current model version + gather download versions ----
+    lagged_flat = None
+    hist = astate.get("hist")
+    if hist is not None:
+        h = hist.shape[0]
+        hist = lax.dynamic_update_index_in_dim(
+            hist, ravel(state.params).astype(hist.dtype), jnp.mod(t, h), axis=0
+        )
+        # per-client start params: the version each client downloaded
+        # (ring depth covers every reachable lag, arrivals.history_len)
+        lagged_flat = jnp.take(
+            hist, jnp.mod(astate["version"], h), axis=0
+        )
+        if engine.plan is not None:
+            # clients-axis constraint ONLY (the model-axis reshard
+            # miscompile rule, core/engine.py)
+            lagged_flat = lax.with_sharding_constraint(
+                lagged_flat, engine.plan.clients
+            )
+
+    # -- 2. fixed-shape training of all K clients + attack + faults ---------
+    updates, new_client_opt, losses, top1s = engine._train_clients(
+        state.params, state.client_opt_state, client_lr, cx, cy,
+        client_keys, lagged_flat=lagged_flat,
+    )
+    updates = jnp.nan_to_num(updates)
+    if engine.plan is not None:
+        updates = lax.with_sharding_constraint(updates, engine.plan.clients)
+    updates, attack_state = engine.attack.on_updates(
+        updates, engine.byz_mask, attack_key, state.attack_state
+    )
+
+    sent_updates = updates
+    fault_state = state.fault_state
+    fault_diag = {}
+    part_mask = None
+    if engine.fault_model is not None:
+        fault_key = jax.random.fold_in(round_key, rng.FAULT)
+        updates, part_mask, fault_state, fault_diag = engine.fault_model.apply(
+            updates, fault_state, fault_key, t
+        )
+
+    # -- 3. deposit into per-client buffer slots ----------------------------
+    if static_sync:
+        arriving = jnp.ones(k, bool)
+        deposit = arriving
+        buf = updates  # all-true deposit: the buffer IS this tick's matrix
+        buf_mask = arriving
+        buf_version = astate["version"]
+        n_deposit = jnp.asarray(k, jnp.int32)
+        count = jnp.asarray(k, jnp.int32)
+        fired = jnp.ones((), bool)  # buffer_m clamps to [1, K]
+    else:
+        arriving = astate["countdown"] <= 0
+        deposit = arriving if part_mask is None else (arriving & part_mask)
+        buf = jnp.where(deposit[:, None], updates, astate["buf"])
+        buf_mask = astate["buf_mask"] | deposit
+        buf_version = jnp.where(
+            deposit, astate["version"], astate["buf_version"]
+        )
+        n_deposit = jnp.sum(deposit.astype(jnp.int32))
+        count = jnp.sum(buf_mask.astype(jnp.int32))
+        fired = count >= jnp.asarray(engine.async_buffer_m, count.dtype)
+    if engine.plan is not None:
+        buf = lax.with_sharding_constraint(buf, engine.plan.clients)
+
+    # -- 4. staleness-weighted aggregation + audit, gated on fire -----------
+    agg_ctx = dict(
+        trusted_mask=engine.trusted_mask,
+        params_flat=ravel(state.params),
+        key=jax.random.fold_in(round_key, rng.AGG),
+    )
+    if static_sync:
+        # staleness is 0 by construction and w(0) normalizes to exactly 1
+        # in every mode; route through the IDENTICAL unmasked calls the
+        # sync body traces (bit-exact degenerate equivalence, see module
+        # docstring)
+        tau = jnp.zeros((k,), jnp.int32)
+        agg_mask = buf_mask
+        weights = jnp.ones((k,), jnp.float32)
+        weighted = buf
+        n_agg = count
+        if engine.collect_diagnostics:
+            agg, agg_state, agg_diag = (
+                engine.aggregator.aggregate_with_diagnostics(
+                    buf, state.agg_state, **agg_ctx
+                )
+            )
+        else:
+            agg, agg_state = engine.aggregator.aggregate(
+                buf, state.agg_state, **agg_ctx
+            )
+            agg_diag = {}
+        audit_diag = {}
+        if engine.audit_monitor is not None:
+            agg, audit_diag = engine.audit_monitor.apply(
+                buf, agg, mask=None, byz_mask=engine.byz_mask, **agg_ctx
+            )
+    else:
+        tau = (t - buf_version).astype(jnp.int32)
+        agg_mask, weights = cfg.staleness_mask_weights(tau, buf_mask)
+        # constant/cutoff: statically NO row multiply (exact identity)
+        weighted = (
+            buf if cfg.weights_are_identity else buf * weights[:, None]
+        )
+        if engine.collect_diagnostics:
+            agg, agg_state, agg_diag = (
+                engine.aggregator.aggregate_masked_with_diagnostics(
+                    weighted, state.agg_state, mask=agg_mask, **agg_ctx
+                )
+            )
+        else:
+            agg, agg_state = engine.aggregator.aggregate_masked(
+                weighted, state.agg_state, mask=agg_mask, **agg_ctx
+            )
+            agg_diag = {}
+        n_agg = jnp.sum(agg_mask.astype(jnp.int32))
+        # graceful skip: an empty aggregated set applies the zero
+        # pseudo-gradient (the sync body's zero-participant rule)
+        agg = jnp.where(n_agg > 0, agg, jnp.zeros_like(agg))
+
+        audit_diag = {}
+        if engine.audit_monitor is not None:
+            # certificates over the staleness-weighted rows the defense
+            # actually consumed; the oracle honest-reference fields compare
+            # against the honest mean of that same weighted set
+            agg, audit_diag = engine.audit_monitor.apply(
+                weighted, agg, mask=agg_mask, byz_mask=engine.byz_mask,
+                **agg_ctx,
+            )
+
+        # gate everything the fire owns: a non-fired tick must leave
+        # model, server-opt and aggregator state bit-untouched
+        agg = jnp.where(fired, agg, jnp.zeros_like(agg))
+        agg_state = _tree_where(fired, agg_state, state.agg_state)
+        if audit_diag:
+            # a breach on a tick that never fired swapped nothing in
+            audit_diag = dict(audit_diag)
+            audit_diag["breach"] = (
+                audit_diag["breach"] * fired.astype(jnp.int32)
+            )
+            audit_diag["fallback_used"] = (
+                audit_diag["fallback_used"] * fired.astype(jnp.int32)
+            )
+            audit_diag["agg_norm"] = jnp.linalg.norm(agg)
+
+    metric_pack = ()
+    if engine.round_metrics:
+        # the pack folds the matrix the defense consumed against the
+        # aggregate the server APPLIES — same contract as the sync bodies
+        metric_pack = pack_dense(
+            weighted, agg_mask, engine.byz_mask, agg,
+            engine.client_chunks, engine.chunk_size,
+        )
+
+    grad_tree = engine.unravel(-agg)
+    server_updates, server_opt_state = engine._server_tx.update(
+        grad_tree, state.server_opt_state, state.params
+    )
+    params = jax.tree_util.tree_map(
+        lambda p, u: p - server_lr * u.astype(p.dtype),
+        state.params,
+        server_updates,
+    )
+    if not static_sync:
+        params = _tree_where(fired, params, state.params)
+        server_opt_state = _tree_where(
+            fired, server_opt_state, state.server_opt_state
+        )
+        # client-side state advances only for clients that really trained
+        # (arrived) this tick — the fixed-shape work of the others is
+        # discarded
+        if engine.client_opt.persist:
+            new_client_opt = _rows_where(
+                arriving, new_client_opt, state.client_opt_state
+            )
+
+    # -- 5. drain on fire; arrived clients re-download + redraw delays ------
+    new_delays = cfg.arrivals.draw(round_key, k)
+    fired_i = fired.astype(jnp.int32)
+    t_next = (t + 1).astype(astate["version"].dtype)
+    new_astate = dict(astate)
+    new_astate["buf"] = buf
+    new_astate["buf_mask"] = buf_mask & ~fired
+    new_astate["buf_version"] = buf_version
+    new_astate["version"] = jnp.where(
+        arriving, t_next, astate["version"]
+    )
+    new_astate["countdown"] = jnp.where(
+        arriving, new_delays, jnp.maximum(astate["countdown"] - 1, 0)
+    )
+    new_astate["fires"] = astate["fires"] + fired_i
+    if hist is not None:
+        new_astate["hist"] = hist
+
+    agg_w = agg_mask.astype(jnp.float32)
+    mean_tau = jnp.where(
+        fired & (n_agg > 0),
+        jnp.sum(tau.astype(jnp.float32) * agg_w)
+        / jnp.maximum(n_agg.astype(jnp.float32), 1.0),
+        0.0,
+    )
+    max_tau = jnp.where(
+        fired, jnp.max(jnp.where(agg_mask, tau, 0)), 0
+    ).astype(jnp.int32)
+    async_diag = {
+        "arrivals": jnp.sum(arriving.astype(jnp.int32)),
+        "deposited": n_deposit,
+        "buffer_count": count,
+        "fired": fired_i,
+        "aggregated": jnp.where(fired, n_agg, 0).astype(jnp.int32),
+        "fires_total": new_astate["fires"],
+        "mean_staleness": mean_tau,
+        "max_staleness": max_tau,
+        "stale_excluded": jnp.sum((buf_mask & ~agg_mask).astype(jnp.int32)),
+        "weight_min": jnp.where(
+            fired & (n_agg > 0),
+            jnp.min(jnp.where(agg_mask, weights, jnp.inf)),
+            1.0,
+        ),
+    }
+
+    honest = (~engine.byz_mask).astype(losses.dtype)
+    n_honest = jnp.maximum(honest.sum(), 1.0)
+    var = sent_updates.var(axis=0)
+    metrics = RoundMetrics(
+        train_loss=(losses * honest).sum() / n_honest,
+        train_loss_all=losses.mean(),
+        train_top1=(top1s * honest).sum() / n_honest,
+        update_variance=var.mean(),
+        update_variance_norm=jnp.linalg.norm(var),
+        agg_norm=jnp.linalg.norm(agg),
+    )
+    new_state = RoundState(
+        params=params,
+        server_opt_state=server_opt_state,
+        client_opt_state=(
+            new_client_opt if engine.client_opt.persist else ()
+        ),
+        agg_state=agg_state,
+        attack_state=attack_state,
+        round_idx=state.round_idx + 1,
+        fault_state=fault_state,
+        async_state=new_astate,
+    )
+    return (
+        new_state,
+        metrics,
+        # same rule as the dense body: under a fault model the observable
+        # matrix is what the server RECEIVED (corruption applied), not
+        # what the clients sent
+        updates if engine.keep_updates else (),
+        agg_diag,
+        fault_diag,
+        audit_diag,
+        metric_pack,
+        async_diag,
+    )

@@ -25,6 +25,8 @@ from blades_tpu.audit.attack_search import (
     QUICK_GRIDS,
     TEMPLATE_NAMES,
     search_cell,
+    search_cell_staleness,
+    staleness_row_weights,
     synthetic_honest,
 )
 from blades_tpu.audit.contracts import (
@@ -56,5 +58,7 @@ __all__ = [
     "nominal_f",
     "run_battery",
     "search_cell",
+    "search_cell_staleness",
+    "staleness_row_weights",
     "synthetic_honest",
 ]

@@ -271,6 +271,106 @@ def search_cell(
     }
 
 
+# -- staleness-aware templates (buffered-async threat model) ------------------
+#
+# Under the buffered-async engine (blades_tpu/asyncfl) the server
+# aggregates STALENESS-WEIGHTED rows. The asynchronous threat model gives
+# the adversary a lever the sync battery never measures: byzantine clients
+# CONTROL THEIR OWN REPORTING TIME, so they choose the staleness weight
+# they will receive — and, since they also control their payload, they can
+# pre-scale it by 1/w to cancel any discount ("IPM/ALIE scaled by the
+# staleness weight they will receive"). The honest population cannot: real
+# stragglers report late and get damped heterogeneously, which DISTORTS
+# the honest geometry every defense reasons over (trim fractions, Krum
+# neighborhoods, clipping radii). The staleness search therefore evaluates
+# the standard template battery on the weighted matrix the server actually
+# sees: honest rows scaled by their (normalized, asyncfl/buffer.py)
+# staleness weights, byzantine rows unconstrained as always.
+
+
+def staleness_row_weights(
+    k: int,
+    f: int,
+    *,
+    mode: str = "polynomial",
+    alpha: float = 0.5,
+    tau_max: int = 3,
+    tau_byz: int = 0,
+    cutoff: Optional[int] = None,
+):
+    """``(mask, weights, tau)`` for one staleness scenario.
+
+    Honest rows carry a deterministic staleness ladder ``0..tau_max``
+    (cycled — a population of mixed-speed clients); byzantine rows all
+    report at ``tau_byz`` (0 = the fresh attacker among damped honest
+    stragglers, the amplified case; ``tau_max`` = maximal-staleness
+    reporting, the attacker hiding behind the straggler excuse).
+    Normalization (mean-1 over the included set) and the cutoff-exclusion
+    rule are delegated to :class:`blades_tpu.asyncfl.AsyncConfig` — single
+    owner of the weighting semantics the engine executes.
+    """
+    from blades_tpu.asyncfl import AsyncConfig
+
+    byz = jnp.arange(k) < f
+    honest_tau = jnp.mod(jnp.maximum(jnp.arange(k) - f, 0), tau_max + 1)
+    tau = jnp.where(byz, tau_byz, honest_tau).astype(jnp.int32)
+    cfg = AsyncConfig(
+        buffer_m=1, staleness=mode, alpha=alpha, cutoff=cutoff
+    )
+    mask, w = cfg.staleness_mask_weights(tau, jnp.ones(k, bool))
+    return mask, w, tau
+
+
+def search_cell_staleness(
+    agg: Aggregator,
+    trials_updates: jnp.ndarray,
+    f: int,
+    *,
+    mode: str = "polynomial",
+    alpha: float = 0.5,
+    tau_max: int = 3,
+    tau_byz: int = 0,
+    cutoff: Optional[int] = None,
+    ctx: Optional[dict] = None,
+    grids: Optional[dict] = None,
+    use_jit: bool = False,
+) -> Dict[str, Any]:
+    """Worst-case deviation search for one (aggregator, f) cell under
+    buffered-async staleness weighting (see the section comment above).
+
+    The honest rows of every trial are pre-scaled by their normalized
+    staleness weights — the matrix the async server aggregates — and the
+    standard five-template adaptive search runs on it (byzantine rows are
+    rewritten by the templates, i.e. the weight-compensating adversary).
+    The resilience reference (honest mean / max honest deviation) is
+    likewise computed on the weighted honest rows: that is the step an
+    honest-only staleness-weighted server would have taken. Returns the
+    ``search_cell`` result dict plus the scenario fields."""
+    if trials_updates.ndim == 2:
+        trials_updates = trials_updates[None]
+    k = trials_updates.shape[1]
+    mask, w, tau = staleness_row_weights(
+        k, f, mode=mode, alpha=alpha, tau_max=tau_max, tau_byz=tau_byz,
+        cutoff=cutoff,
+    )
+    weighted = trials_updates * w[None, :, None]
+    part = None if bool(jnp.all(mask)) else mask
+    out = search_cell(
+        agg, weighted, f, ctx=ctx, grids=grids, part_mask=part,
+        use_jit=use_jit,
+    )
+    out["staleness"] = {
+        "mode": mode,
+        "alpha": alpha,
+        "tau_max": int(tau_max),
+        "tau_byz": int(tau_byz),
+        **({"cutoff": int(cutoff)} if cutoff is not None else {}),
+        "weight_byz": float(w[0]) if f > 0 else None,
+        "weight_min": float(jnp.min(jnp.where(mask, w, jnp.inf))),
+    }
+    return out
+
+
 def synthetic_honest(
     key: jax.Array, trials: int, k: int, d: int,
     center_scale: float = 2.0, spread: float = 1.0,

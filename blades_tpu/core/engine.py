@@ -40,6 +40,7 @@ import optax
 from jax import lax
 
 from blades_tpu.aggregators.base import Aggregator
+from blades_tpu.asyncfl.engine import async_round
 from blades_tpu.attackers.base import Attack, NoAttack
 from blades_tpu.audit.monitor import AuditMonitor
 from blades_tpu.faults import FaultModel
@@ -132,6 +133,13 @@ class RoundState(NamedTuple):
     # model is installed — checkpointed with everything else so a resumed
     # run replays the exact straggler history
     fault_state: Any = ()
+    # buffered-async state (blades_tpu.asyncfl): server buffer + occupancy,
+    # per-client download versions / arrival countdowns, fire counter and
+    # (when arrivals can lag) the version-lagged params ring — () for sync
+    # engines, so sync checkpoints/programs are byte-identical to before
+    # the async subsystem existed. Riding RoundState makes kill -> resume
+    # with a NON-EMPTY buffer bit-exact for free.
+    async_state: Any = ()
 
 
 class RoundMetrics(NamedTuple):
@@ -183,6 +191,7 @@ class RoundEngine:
         audit_monitor: Optional[AuditMonitor] = None,
         streaming: bool = False,
         round_metrics: bool = False,
+        async_config: Optional[Any] = None,
     ):
         """``client_chunks``: split the K client axis into this many
         sequential chunks (``lax.map`` outside, vmap inside). Each chunk still
@@ -276,7 +285,24 @@ class RoundEngine:
         ``streaming`` for identical row content (see
         ``telemetry/metric_pack.py``). Per round the pack lands in
         ``self.last_metric_pack`` and (under :class:`Simulator`) as one
-        ``metrics`` telemetry record."""
+        ``metrics`` telemetry record.
+
+        ``async_config``: a :class:`blades_tpu.asyncfl.AsyncConfig` —
+        switch the engine to **buffered-asynchronous** (FedBuff-style)
+        round semantics: clients arrive on a seeded fixed-shape schedule,
+        train against the model version they downloaded, and the server
+        aggregates the buffered first-M arrivals with staleness-weighted
+        rows (``blades_tpu/asyncfl/engine.py`` is the round body; it is a
+        sibling of the dense/streaming bodies, so ``run_round`` /
+        ``run_block`` / checkpointing / telemetry ride unchanged and the
+        per-tick async counters land in ``self.last_async_diag``).
+        ``buffer_m`` is clamped into ``[1, K]``. Static branch: ``None``
+        (default) compiles the exact synchronous program. Incompatible
+        with ``streaming=True`` (the buffer is ``[K, D]`` state — the
+        memory the streaming engine exists to avoid, same class as the
+        fault layer's straggler replay buffers) and with straggler fault
+        models (async staleness *replaces* the sync straggler-replay
+        semantics; dropout/corruption faults compose)."""
         self.train_loss_fn = train_loss_fn
         self.eval_logits_fn = eval_logits_fn
         self.num_clients = int(num_clients)
@@ -310,6 +336,29 @@ class RoundEngine:
         self.last_audit_diag: Any = None
         self.round_metrics = bool(round_metrics)
         self.last_metric_pack: Any = None
+        self.async_config = async_config
+        self.last_async_diag: Any = None
+        self.async_buffer_m = 0
+        if async_config is not None:
+            if self.streaming:
+                raise ValueError(
+                    "async_config is incompatible with streaming=True: the "
+                    "server buffer is [K, D] state — the memory the "
+                    "streaming chunk scan exists to avoid (same class as "
+                    "straggler replay buffers)"
+                )
+            if self.aggregator is None:
+                raise ValueError("async_config requires an aggregator")
+            if fault_model is not None and fault_model.has_stragglers:
+                raise ValueError(
+                    "async_config replaces the sync straggler-replay "
+                    "semantics with real arrival staleness; configure the "
+                    "fault model without stragglers (straggler_rate=0)"
+                )
+            # first-M threshold clamps to the population (buffer slots are
+            # per-client, so K is the buffer bound)
+            self.async_buffer_m = max(1, min(int(async_config.buffer_m),
+                                             self.num_clients))
         if self.streaming:
             self._validate_streaming(aggregator, attack, fault_model,
                                      audit_monitor, collect_diagnostics)
@@ -414,6 +463,11 @@ class RoundEngine:
             if self.fault_model is not None
             else ()
         )
+        async_state = (
+            self.async_config.init_state(self.num_clients, self.dim)
+            if self.async_config is not None
+            else ()
+        )
         state = RoundState(
             params=params,
             server_opt_state=server_opt_state,
@@ -422,6 +476,7 @@ class RoundEngine:
             attack_state=attack_state,
             round_idx=jnp.asarray(0, jnp.int32),
             fault_state=fault_state,
+            async_state=async_state,
         )
         return self.place_state(state)
 
@@ -431,6 +486,24 @@ class RoundEngine:
         therefore the same compiled executable, bit-exactly) as a live one."""
         if self.plan is None:
             return state
+        async_state = state.async_state
+        if self.async_config is not None and async_state:
+            # [K, ...]-leading async leaves (the buffer + per-client
+            # bookkeeping) go along the clients axis — matching the
+            # constraint the round body puts on the buffer — while the
+            # version ring ([max_delay+1, D]: params history, NOT a client
+            # axis) and the scalar fire counter replicate
+            async_state = dict(async_state)
+            for name in ("buf", "buf_mask", "buf_version", "version",
+                         "countdown"):
+                async_state[name] = jax.device_put(
+                    async_state[name], self.plan.clients
+                )
+            async_state["fires"] = self.plan.replicate(async_state["fires"])
+            if "hist" in async_state:
+                async_state["hist"] = self.plan.replicate(
+                    async_state["hist"]
+                )
         return state._replace(
             params=self.plan.replicate(state.params),
             server_opt_state=self.plan.replicate(state.server_opt_state),
@@ -439,6 +512,7 @@ class RoundEngine:
             )
             if self.client_opt.persist
             else (),
+            async_state=async_state,
         )
 
     # -- the round program ---------------------------------------------------
@@ -530,34 +604,52 @@ class RoundEngine:
         return update, ostf, losses.mean(), top1s.mean()
 
     def _round(self, state: RoundState, cx, cy, client_lr, server_lr, key):
-        """Static dispatch between the dense round body and the streaming
-        chunk scan — both trace to the same output structure, so
-        ``run_round``/``run_block`` never care which one compiled."""
+        """Static dispatch between the dense round body, the streaming
+        chunk scan, and the buffered-async body (``blades_tpu/asyncfl``) —
+        all trace to the same output structure, so ``run_round``/
+        ``run_block`` never care which one compiled."""
+        if self.async_config is not None:
+            return async_round(self, state, cx, cy, client_lr, server_lr, key)
         if self.streaming:
             return self._round_streaming(state, cx, cy, client_lr, server_lr, key)
         return self._round_dense(state, cx, cy, client_lr, server_lr, key)
 
-    def _round_dense(self, state: RoundState, cx, cy, client_lr, server_lr, key):
-        round_key = rng.key_for_round(key, state.round_idx)
-        client_keys = rng.key_per_client(round_key, self.num_clients)
-        attack_key = jax.random.fold_in(round_key, rng.ATTACK)
+    def _train_clients(
+        self, params, client_opt_state, client_lr, cx, cy, client_keys,
+        lagged_flat=None,
+    ):
+        """Fixed-shape local training of all K clients (vmapped, optionally
+        chunk-mapped): ``(updates [K, D], new_client_opt, losses [K],
+        top1s [K])``. The single owner of the client-axis training layout,
+        shared by the dense sync body and the buffered-async body
+        (``blades_tpu/asyncfl/engine.py``).
 
-        if self.plan is not None:
-            cx = lax.with_sharding_constraint(cx, self.plan.clients)
-            cy = lax.with_sharding_constraint(cy, self.plan.clients)
-
-        if self.client_opt.persist:
-            in_axes = (None, 0, None, 0, 0, 0, 0, 0)
-            opt_arg = state.client_opt_state
+        ``lagged_flat``: optional ``[K, D]`` per-client flat *start*
+        params (the async version lag — each client trains from the model
+        version it downloaded, unraveled per row). ``None`` (the sync
+        path, and async with ``max_delay == 0``) trains every client from
+        the shared ``params`` through the exact same broadcast vmap as
+        always — keeping the zero-lag async program bit-identical to the
+        sync one."""
+        persist = self.client_opt.persist
+        opt_arg = client_opt_state if persist else ()
+        if lagged_flat is None:
+            fn = self._local_update
+            in_axes = (None, 0 if persist else None, None, 0, 0, 0, 0, 0)
         else:
-            in_axes = (None, None, None, 0, 0, 0, 0, 0)
-            opt_arg = ()
-        vmapped = jax.vmap(self._local_update, in_axes=in_axes)
+            def fn(flat_p, opt, lr, x, y, kk, byz, idx):
+                return self._local_update(
+                    self.unravel(flat_p), opt, lr, x, y, kk, byz, idx
+                )
+
+            in_axes = (0, 0 if persist else None, None, 0, 0, 0, 0, 0)
+        vmapped = jax.vmap(fn, in_axes=in_axes)
         client_ids = jnp.arange(self.num_clients, dtype=jnp.int32)
 
         if self.client_chunks == 1:
+            p_arg = params if lagged_flat is None else lagged_flat
             updates, new_client_opt, losses, top1s = vmapped(
-                state.params, opt_arg, client_lr, cx, cy, client_keys,
+                p_arg, opt_arg, client_lr, cx, cy, client_keys,
                 self.byz_mask, client_ids,
             )
         else:
@@ -569,24 +661,48 @@ class RoundEngine:
             # before any matrix the attack/defense sees.
             chunked, unchunk = self._chunk_fns()
 
-            opt_c = chunked(opt_arg) if self.client_opt.persist else opt_arg
+            opt_c = chunked(opt_arg) if persist else opt_arg
 
-            def run_chunk(args):
-                o, x, y, k, b, ids = args
-                return vmapped(state.params, o if self.client_opt.persist else (),
-                               client_lr, x, y, k, b, ids)
+            if lagged_flat is None:
+                def run_chunk(args):
+                    o, x, y, k, b, ids = args
+                    return vmapped(params, o if persist else (),
+                                   client_lr, x, y, k, b, ids)
 
-            updates, new_client_opt, losses, top1s = lax.map(
-                run_chunk,
-                (opt_c, chunked(cx), chunked(cy), chunked(client_keys),
-                 chunked(self.byz_mask), chunked(client_ids)),
-            )
+                xs = (opt_c, chunked(cx), chunked(cy), chunked(client_keys),
+                      chunked(self.byz_mask), chunked(client_ids))
+            else:
+                def run_chunk(args):
+                    p, o, x, y, k, b, ids = args
+                    return vmapped(p, o if persist else (),
+                                   client_lr, x, y, k, b, ids)
+
+                xs = (chunked(lagged_flat), opt_c, chunked(cx), chunked(cy),
+                      chunked(client_keys), chunked(self.byz_mask),
+                      chunked(client_ids))
+
+            updates, new_client_opt, losses, top1s = lax.map(run_chunk, xs)
 
             updates, losses, top1s = unchunk((updates, losses, top1s))
-            if self.client_opt.persist:
+            if persist:
                 new_client_opt = unchunk(new_client_opt)
-        if not self.client_opt.persist:
+        if not persist:
             new_client_opt = ()
+        return updates, new_client_opt, losses, top1s
+
+    def _round_dense(self, state: RoundState, cx, cy, client_lr, server_lr, key):
+        round_key = rng.key_for_round(key, state.round_idx)
+        client_keys = rng.key_per_client(round_key, self.num_clients)
+        attack_key = jax.random.fold_in(round_key, rng.ATTACK)
+
+        if self.plan is not None:
+            cx = lax.with_sharding_constraint(cx, self.plan.clients)
+            cy = lax.with_sharding_constraint(cy, self.plan.clients)
+
+        updates, new_client_opt, losses, top1s = self._train_clients(
+            state.params, state.client_opt_state, client_lr, cx, cy,
+            client_keys,
+        )
 
         # parity: reference nan_to_num's every uploaded update (client.py:195-198)
         updates = jnp.nan_to_num(updates)
@@ -733,6 +849,7 @@ class RoundEngine:
             fault_diag,
             audit_diag,
             metric_pack,
+            {},  # async diagnostics (buffered-async body only)
         )
 
     def _round_streaming(self, state: RoundState, cx, cy, client_lr, server_lr, key):
@@ -945,7 +1062,10 @@ class RoundEngine:
             round_idx=state.round_idx + 1,
             fault_state=fault_state,
         )
-        return new_state, metrics, (), {}, fault_diag, audit_diag, metric_pack
+        return (
+            new_state, metrics, (), {}, fault_diag, audit_diag, metric_pack,
+            {},  # async diagnostics (buffered-async body only)
+        )
 
     def run_round(
         self,
@@ -978,6 +1098,7 @@ class RoundEngine:
                 fault_diag,
                 audit_diag,
                 metric_pack,
+                async_diag,
             ) = self._round_jit(
                 state,
                 cx,
@@ -993,6 +1114,9 @@ class RoundEngine:
             audit_diag if self.audit_monitor is not None else None
         )
         self.last_metric_pack = metric_pack if self.round_metrics else None
+        self.last_async_diag = (
+            async_diag if self.async_config is not None else None
+        )
         return new_state, metrics
 
     # -- round-block execution -----------------------------------------------
@@ -1009,10 +1133,11 @@ class RoundEngine:
                 cx, cy = sampler(skey)
                 (
                     new_st, metrics, _updates, agg_diag, fault_diag,
-                    audit_diag, metric_pack,
+                    audit_diag, metric_pack, async_diag,
                 ) = self._round(st, cx, cy, c_lr, s_lr, key)
                 return new_st, (
-                    metrics, agg_diag, fault_diag, audit_diag, metric_pack
+                    metrics, agg_diag, fault_diag, audit_diag, metric_pack,
+                    async_diag,
                 )
 
             final, ys = lax.scan(
@@ -1047,8 +1172,8 @@ class RoundEngine:
 
         Returns ``(new_state, metrics, diags)``: stacked ``[R]``-leading
         :class:`RoundMetrics`, and a dict with the stacked per-round
-        ``defense`` / ``faults`` / ``audit`` diagnostics (``None`` for
-        surfaces not installed). Bit-exactness contract: an R-round block
+        ``defense`` / ``faults`` / ``audit`` / ``metrics`` / ``async``
+        diagnostics (``None`` for surfaces not installed). Bit-exactness contract: an R-round block
         equals R sequential :meth:`run_round` calls bit-for-bit
         (``tests/test_engine.py``), so blocks are a pure scheduling choice.
         ``last_updates`` is ``None`` after a block (the matrix is consumed
@@ -1061,7 +1186,9 @@ class RoundEngine:
             self._block_sampler = sampler
         r = int(sample_keys.shape[0])
         with get_recorder().span("dispatch", rounds=r):
-            new_state, (metrics, agg_diag, fault_diag, audit_diag, mpacks) = (
+            new_state, (
+                metrics, agg_diag, fault_diag, audit_diag, mpacks, adiags,
+            ) = (
                 self._block_jit(
                     state,
                     sample_keys,
@@ -1080,11 +1207,15 @@ class RoundEngine:
             last(audit_diag) if self.audit_monitor is not None else None
         )
         self.last_metric_pack = last(mpacks) if self.round_metrics else None
+        self.last_async_diag = (
+            last(adiags) if self.async_config is not None else None
+        )
         diags = {
             "defense": agg_diag if self.collect_diagnostics else None,
             "faults": fault_diag if self.fault_model is not None else None,
             "audit": audit_diag if self.audit_monitor is not None else None,
             "metrics": mpacks if self.round_metrics else None,
+            "async": adiags if self.async_config is not None else None,
         }
         return new_state, metrics, diags
 

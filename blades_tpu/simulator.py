@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from blades_tpu.aggregators import get_aggregator
+from blades_tpu.asyncfl import AsyncConfig
 from blades_tpu.attackers import ATTACKS, get_attack
 from blades_tpu.attackers.base import Attack, NoAttack
 from blades_tpu.audit.monitor import AuditMonitor
@@ -322,6 +323,7 @@ class Simulator:
         block_size: int = 1,
         streaming: bool = False,
         round_metrics: Optional[bool] = None,
+        async_config: Optional[Union[AsyncConfig, Dict]] = None,
     ) -> List[float]:
         """Run adversarial training; returns per-round wall times (reference
         ``run`` contract, ``simulator.py:364-457``).
@@ -403,6 +405,22 @@ class Simulator:
         outputs and is unstacked here). Default: the
         ``BLADES_ROUND_METRICS=1`` env knob; off compiles the exact
         pre-metrics program.
+        ``async_config``: a :class:`blades_tpu.asyncfl.AsyncConfig` (or a
+        kwargs dict for one — its ``arrivals`` entry may itself be an
+        :class:`~blades_tpu.asyncfl.ArrivalProcess` kwargs dict) switching
+        the run to **buffered-asynchronous** (FedBuff-style) rounds:
+        clients arrive on a seeded fixed-shape schedule, train against the
+        model version they downloaded, and each round the server
+        aggregates the buffered first-``buffer_m`` arrivals with
+        staleness-weighted rows — still one jitted program per round
+        (``docs/robustness.md`` "Asynchronous scenarios"). Composes with
+        ``block_size``, fault models (dropout/corruption; stragglers are
+        replaced by real staleness and raise), the audit monitor (the
+        certificates run over the staleness-weighted buffer), and
+        crash-autosave/bit-exact resume (the buffer rides the checkpoint).
+        Incompatible with ``streaming=True``. One ``async`` telemetry
+        record per round (arrivals, buffer fill, fire flag, staleness
+        moments; ``docs/observability.md``).
 
         Telemetry (``docs/observability.md``): unless ``BLADES_TELEMETRY=0``,
         a span/counter trace of the run is appended to
@@ -446,6 +464,8 @@ class Simulator:
             fault_model = FaultModel(**fault_model)
         if isinstance(audit_monitor, dict):
             audit_monitor = AuditMonitor(**audit_monitor)
+        if isinstance(async_config, dict):
+            async_config = AsyncConfig(**async_config)
         # validate BEFORE any process-wide state changes below (the
         # supervised SIGTERM handler install): a config error must raise
         # clean, not leak a signal handler to a caller that catches it
@@ -479,6 +499,11 @@ class Simulator:
             "block_size": block_size,
             "streaming": streaming,
             **({"fault_model": repr(fault_model)} if fault_model else {}),
+            **(
+                {"async_config": repr(async_config)}
+                if async_config is not None
+                else {}
+            ),
         }
         config_fp = _ledger.config_fingerprint(run_config)
         trace_path = os.path.join(self.log_path, "telemetry.jsonl")
@@ -512,6 +537,11 @@ class Simulator:
                 **(
                     {"audit_monitor": repr(audit_monitor)}
                     if audit_monitor is not None
+                    else {}
+                ),
+                **(
+                    {"async_config": repr(async_config)}
+                    if async_config is not None
                     else {}
                 ),
             },
@@ -580,6 +610,7 @@ class Simulator:
                 audit_monitor=audit_monitor,
                 streaming=streaming,
                 round_metrics=round_metrics,
+                async_config=async_config,
             )
             # memory observability: the round program's peak update-matrix
             # footprint rides every round record as gauges (streaming rounds
@@ -589,6 +620,11 @@ class Simulator:
             rec.gauge("engine.client_chunks", self.engine.client_chunks)
             rec.gauge("engine.chunk_size", self.engine.chunk_size)
             rec.gauge("engine.streaming", int(self.engine.streaming))
+            if async_config is not None:
+                # async semantics gauges: every round record is
+                # self-describing about the buffer threshold in force
+                rec.gauge("engine.async", 1)
+                rec.gauge("engine.async_buffer_m", self.engine.async_buffer_m)
             # supervised runs: SIGTERM (the supervisor's first escalation step)
             # becomes an in-loop exception so the crash autosave below fires
             # before SIGKILL; main-thread only (signal.signal's constraint).
@@ -753,6 +789,7 @@ class Simulator:
                         self._log_faults(rnd)
                         self._log_audit(rnd)
                         self._log_metrics(rnd)
+                        self._log_async(rnd)
                         if rnd == start_round:
                             # one measured program profile per run: XLA
                             # cost/memory analysis of the exact compiled
@@ -975,6 +1012,8 @@ class Simulator:
                         self._log_metrics(
                             r, pack=slice_round(diags["metrics"], i)
                         )
+                    if diags["async"] is not None:
+                        self._log_async(r, diag=slice_round(diags["async"], i))
 
                 if not profiled:
                     # one measured program profile per run (the scanned
@@ -1191,6 +1230,31 @@ class Simulator:
         self.telemetry.event(
             "audit", round=rnd, agg=repr(self.aggregator), **fields
         )
+
+    def _log_async(self, rnd: int, diag=None) -> None:
+        """Buffered-async forensics -> one ``async`` telemetry record per
+        round: arrivals, deposits, buffer fill, the fire flag, aggregated
+        row count, cumulative fires, staleness moments over the fired set,
+        the minimum normalized staleness weight, and cutoff exclusions
+        (``blades_tpu/asyncfl``; ``diag`` = one round's slice under
+        round-block scheduling). The buffer/fire headline also lands as
+        gauges so every ``round`` record carries the latest values.
+        Reference counterpart: none — the reference is strictly
+        synchronous (``src/blades/simulator.py:203-247``)."""
+        if diag is None:
+            diag = getattr(self.engine, "last_async_diag", None)
+        if not diag or not self.telemetry.enabled:
+            return
+        fields = {}
+        for name, v in diag.items():
+            arr = np.asarray(v)
+            fields[name] = (
+                float(arr) if arr.dtype.kind == "f" else int(arr)
+            )
+        for name in ("buffer_count", "fired", "mean_staleness"):
+            self.telemetry.gauge(f"async.{name}", fields[name])
+        self.telemetry.counter("async.fires", fields.get("fired", 0))
+        self.telemetry.event("async", round=rnd, **fields)
 
     def _log_metrics(self, rnd: int, pack=None) -> None:
         """In-graph round metrics -> one ``metrics`` telemetry record per

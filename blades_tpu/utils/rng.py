@@ -33,6 +33,10 @@ EVAL = 4
 CLIENTS = 5
 AGG = 6
 FAULT = 7
+# buffered-async arrival process (blades_tpu.asyncfl): per-client integer
+# delay draws — its own stream so adding async semantics never perturbs
+# the data/attack/fault draws of an existing seed
+ARRIVAL = 8
 
 
 def set_random_seed(seed: int = 0) -> jax.Array:

@@ -62,6 +62,7 @@ GALLERY = [
     ("metrics_trace.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
     ("fault_injection.py",
      ["--rounds", "2", "--out", "@TMP@", "--aggs", "median"], {}, 900),
+    ("async_fedbuff.py", ["--rounds", "4", "--out", "@TMP@"], {}, 900),
     ("defense_audit.py", ["--rounds", "2", "--out", "@TMP@"], {}, 900),
     ("supervised_run.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
     ("run_ledger.py", ["--rounds", "3", "--out", "@TMP@"], {}, 900),
@@ -95,6 +96,10 @@ API_MODULES = [
     "blades_tpu.client",
     "blades_tpu.server",
     "blades_tpu.core.engine",
+    "blades_tpu.asyncfl",
+    "blades_tpu.asyncfl.arrivals",
+    "blades_tpu.asyncfl.buffer",
+    "blades_tpu.asyncfl.engine",
     "blades_tpu.aggregators",
     "blades_tpu.attackers",
     "blades_tpu.faults",

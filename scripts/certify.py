@@ -13,9 +13,18 @@ artifact ``results/certification/cert_matrix.json``:
    cell carrying the worst-case deviation found by the adaptive search and
    its pass/fail against the resilience bound
    ``||agg - mean(honest)|| <= c * max honest deviation``;
-3. the headline expectations (median / krum / centeredclipping certify at
-   their nominal f; mean fails every f >= 1) checked in-process — ``ok``
-   in the summary means the matrix matches the theory.
+3. **staleness-aware async columns** — the same search per (aggregator,
+   f) under the buffered-async threat model (``blades_tpu/asyncfl``):
+   honest rows staleness-weighted on a 0..tau_max ladder (polynomial
+   weighting), byzantine rows reporting at their CHOSEN staleness — fresh
+   (``fresh_byz``, the amplified attacker among damped honest stragglers)
+   and maximal (``stale_byz``, hiding behind the straggler excuse),
+   payloads compensated by the weight they will receive
+   (``audit.search_cell_staleness``);
+4. the headline expectations (median / krum / centeredclipping certify at
+   their nominal f, sync AND under both staleness scenarios; mean fails
+   every f >= 1, sync and async) checked in-process — ``ok`` in the
+   summary means the matrix matches the theory.
 
 One-JSON-line contract (same discipline as ``bench.py``): stdout carries
 exactly one JSON summary line, even when the sweep itself raises, so the
@@ -85,6 +94,7 @@ def certify_matrix(args) -> dict:
         nominal_f,
         run_battery,
         search_cell,
+        search_cell_staleness,
         synthetic_honest,
     )
 
@@ -98,7 +108,7 @@ def certify_matrix(args) -> dict:
     trials_updates = synthetic_honest(key, trials, k, d)
     ctx = battery_ctx(None, k, d, key=jax.random.fold_in(key, 1))
 
-    battery, cells = {}, []
+    battery, cells, async_cells = {}, [], []
     for name in names:
         base, _, _ = name.partition(":")
         f_nom = nominal_f(base, k)
@@ -148,6 +158,37 @@ def certify_matrix(args) -> dict:
                 },
                 "search_s": round(time.time() - t0, 2),
             })
+            # -- staleness-aware async columns (same cell, two byzantine
+            #    reporting-time choices; skipped with --no-async) ------------
+            if args.no_async:
+                continue
+            for scenario, tau_byz in (
+                ("fresh_byz", 0), ("stale_byz", args.tau_max),
+            ):
+                t0 = time.time()
+                acell = search_cell_staleness(
+                    agg_f, trials_updates, f,
+                    mode="polynomial", alpha=0.5,
+                    tau_max=args.tau_max, tau_byz=tau_byz,
+                    ctx=ctx, grids=grids, use_jit=not args.no_jit,
+                )
+                async_cells.append({
+                    "agg": name,
+                    "f": f,
+                    "nominal_f": f_nom,
+                    "scenario": scenario,
+                    "worst_dev": round(acell["worst_dev"], 6),
+                    "worst_ratio": round(acell["worst_ratio"], 4),
+                    "rho": round(acell["rho"], 6),
+                    "certified": bool(acell["worst_ratio"] <= c),
+                    "within_nominal": f <= f_nom,
+                    "staleness": acell["staleness"],
+                    "templates": {
+                        t: round(v["worst_ratio"], 4)
+                        for t, v in acell["templates"].items()
+                    },
+                    "search_s": round(time.time() - t0, 2),
+                })
 
     # -- headline expectations ------------------------------------------------
     by = {(r["agg"], r["f"]): r for r in cells}
@@ -172,6 +213,35 @@ def certify_matrix(args) -> dict:
             if not r["ok"] and not r["optout"]:
                 failures.append(f"{name}: {cname} fails without an opt-out")
 
+    # -- async headline expectations -----------------------------------------
+    # mean must break under staleness weighting exactly as it does sync
+    # (the weight-compensating adversary is unconstrained), and the robust
+    # headliners must reproduce their certification over the
+    # staleness-weighted honest geometry in BOTH byzantine reporting-time
+    # scenarios — staleness weighting must not open a robustness hole
+    a_by = {(r["agg"], r["f"], r["scenario"]): r for r in async_cells}
+    if async_cells:
+        for name in HEADLINE_CERTIFY:
+            if not any(n.partition(":")[0] == name for n in names):
+                continue
+            f_nom = nominal_f(name, k)
+            for f in range(f_nom + 1):
+                for scenario in ("fresh_byz", "stale_byz"):
+                    acell = a_by.get((name, f, scenario))
+                    if acell is not None and not acell["certified"]:
+                        failures.append(
+                            f"{name} fails at nominal f={f} under "
+                            f"staleness ({scenario})"
+                        )
+        if any(n == HEADLINE_FAIL for n in names):
+            for f in range(1, f_max + 1):
+                acell = a_by.get((HEADLINE_FAIL, f, "fresh_byz"))
+                if acell is not None and acell["certified"]:
+                    failures.append(
+                        f"mean certifies at f={f} under staleness "
+                        "(must break)"
+                    )
+
     matrix = {
         "metric": METRIC,
         "clients": k,
@@ -182,8 +252,10 @@ def certify_matrix(args) -> dict:
         "grids": "quick" if args.quick else "default",
         "seed": args.seed,
         "templates_per_cell": 5,
+        "tau_max": args.tau_max,
         "battery": battery,
         "cells": cells,
+        "async_cells": async_cells,
         "headline_failures": failures,
         "ok": not failures,
     }
@@ -205,6 +277,11 @@ def main() -> int:
                    help="subset of the pool (default: the full CERT_POOL)")
     p.add_argument("--quick", action="store_true",
                    help="reduced grids/bisection (tests)")
+    p.add_argument("--no-async", action="store_true",
+                   help="skip the staleness-aware async columns")
+    p.add_argument("--tau-max", type=int, default=3,
+                   help="honest staleness ladder bound for the async "
+                        "columns (rounds)")
     p.add_argument("--no-jit", action="store_true",
                    help="eager per-cell evaluation (tiny matrices only)")
     p.add_argument("--out", default=os.path.join(REPO, "results",
@@ -250,6 +327,10 @@ def main() -> int:
                 r["certified"] for r in matrix["cells"] if r["within_nominal"]
             ),
             "nominal_cells": sum(r["within_nominal"] for r in matrix["cells"]),
+            "async_cells": len(matrix["async_cells"]),
+            "async_certified": sum(
+                r["certified"] for r in matrix["async_cells"]
+            ),
             "headline_failures": matrix["headline_failures"],
             "wall_s": matrix["wall_s"],
             "artifact": os.path.relpath(artifact, REPO),

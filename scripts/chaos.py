@@ -35,7 +35,13 @@ per scenario (``check_invariants``):
    ``Simulator.run(block_size=2)`` (the ``lax.scan`` round-block program
    with the sampler fused in) and must produce bit-identical final
    parameters: block scheduling composes with fault weather and the audit
-   monitor without moving the model.
+   monitor without moving the model;
+7. **buffered-async accounting** — every 6th seed runs FedBuff-style
+   buffered-asynchronous rounds (``blades_tpu/asyncfl``) under the same
+   fault weather: one ``async`` telemetry record per round whose buffer
+   arithmetic is self-consistent (the fire flag IS the first-M test,
+   deposits never exceed arrivals, the cumulative fire counter is
+   monotone), with all the invariants above still holding.
 
 Usage::
 
@@ -124,7 +130,7 @@ def make_scenario(seed: int) -> dict:
     if not fault:
         fault["dropout_rate"] = 0.3  # every scenario carries some weather
 
-    return {
+    scn = {
         "seed": seed,
         "agg": agg,
         "agg_kws": agg_kws,
@@ -134,6 +140,31 @@ def make_scenario(seed: int) -> dict:
         "rounds": ROUNDS,
         "sim_seed": int(rng.integers(10_000)),
     }
+
+    # async slice: every 6th seed runs buffered-asynchronous rounds
+    # (blades_tpu/asyncfl) — FedBuff semantics crossed with the same fault
+    # weather. Drawn from a FRESH rng stream keyed off the seed so adding
+    # the slice never perturbed the existing scenarios' draws (the
+    # committed sweep stays comparable), and the decision is seed-derived
+    # (not draw-derived) for the same reason.
+    if seed % 6 == 5:
+        arng = np.random.default_rng(5000 + seed)
+        # straggler replay is the SYNC staleness model; the async engine
+        # replaces it with real arrival staleness (and rejects it)
+        fault.pop("straggler_rate", None)
+        fault.pop("max_staleness", None)
+        if not fault:
+            fault["dropout_rate"] = 0.3
+        scn["async"] = {
+            "buffer_m": int(arng.integers(2, NUM_CLIENTS - 1)),
+            "arrivals": {
+                "kind": "uniform",
+                "max_delay": int(arng.integers(1, 4)),
+            },
+            "staleness": str(arng.choice(["constant", "polynomial"])),
+            "alpha": 0.5,
+        }
+    return scn
 
 
 def inertness_variant(scn: dict) -> dict | None:
@@ -196,6 +227,10 @@ def run_scenario(
         global_rounds=scn["rounds"], local_steps=1, train_batch_size=8,
         client_lr=0.2, server_lr=1.0, validate_interval=scn["rounds"],
         fault_model=dict(scn["fault"]),
+        # async slice: buffered-async rounds under the same fault weather
+        async_config=(
+            dict(scn["async"]) if scn.get("async") is not None else None
+        ),
         # record-only runtime audit (no fallback): every round's certificate
         # verdicts + honest-mean deviation land in the telemetry trace for
         # invariant 4 (blades_tpu/audit, docs/robustness.md)
@@ -250,6 +285,35 @@ def check_invariants(scn: dict, log_path: str, params) -> list:
                 f"round {r['round']}: excluded {r['excluded_nonfinite']} "
                 f"> corrupted {r['corrupted']} (honest rows went non-finite)"
             )
+    # async slice (invariant 7): buffered-async scenarios carry one
+    # `async` record per round with self-consistent buffer accounting —
+    # the fire flag IS the first-M threshold test, deposits never exceed
+    # arrivals, and the cumulative fire counter is monotone
+    if scn.get("async") is not None:
+        asy = [r for r in recs if r.get("t") == "async"]
+        if len(asy) != scn["rounds"]:
+            violations.append(
+                f"expected {scn['rounds']} async records, got {len(asy)}"
+            )
+        m_thresh = min(scn["async"]["buffer_m"], NUM_CLIENTS)
+        prev_fires = 0
+        for r in asy:
+            if r["fired"] != int(r["buffer_count"] >= m_thresh):
+                violations.append(
+                    f"round {r['round']}: fired={r['fired']} but "
+                    f"buffer_count={r['buffer_count']} vs m={m_thresh}"
+                )
+            if r["deposited"] > r["arrivals"]:
+                violations.append(
+                    f"round {r['round']}: deposited {r['deposited']} > "
+                    f"arrivals {r['arrivals']}"
+                )
+            if r["fires_total"] < prev_fires:
+                violations.append(
+                    f"round {r['round']}: fires_total went backwards"
+                )
+            prev_fires = r["fires_total"]
+
     rounds_done = [r for r in recs if r.get("t") == "round"]
     for r in rounds_done:
         if not np.isfinite(r.get("train_loss", 0.0)):
@@ -353,6 +417,7 @@ def sweep(n: int, out_dir: str) -> dict:
                 v.append("block_size=2 changed final params")
         results.append({
             "seed": seed, "agg": scn["agg"], "attack": scn["attack"],
+            "async": scn.get("async"),
             "fault": {k: ("schedule" if k == "participation_schedule" else val)
                       for k, val in scn["fault"].items()},
             "loss": round(float(ev["Loss"]), 4),
@@ -368,6 +433,7 @@ def sweep(n: int, out_dir: str) -> dict:
         "aggregators_covered": sorted({r["agg"] for r in results}),
         "inertness_pairs": sum(r["twin_checked"] for r in results),
         "block_pairs": sum(r["block_checked"] for r in results),
+        "async_scenarios": sum(r["async"] is not None for r in results),
         "violations": violations,
         "ok": not violations,
         "results": results,

@@ -59,6 +59,7 @@ def summarize(records: List[dict]) -> dict:
     profile_events = []
     margins = []
     alerts = []
+    asyncs = []
     supervisor: Dict[str, int] = {}
     kill_reasons = []
     meta = {}
@@ -90,6 +91,8 @@ def summarize(records: List[dict]) -> dict:
             audits.append(r)
         elif t == "metrics":
             metrics.append(r)
+        elif t == "async":
+            asyncs.append(r)
         elif t == "memory":
             programs.append(r)
         elif t == "profile":
@@ -214,6 +217,29 @@ def summarize(records: List[dict]) -> dict:
         excl = [m.get("masked_out", 0) for m in metrics]
         metrics_summary["max_masked_out"] = max(excl) if excl else 0
 
+    # buffered-async rollup (`async` records, blades_tpu/asyncfl): fire
+    # cadence + staleness over the run — the quick health read for a
+    # FedBuff-style run (a fire rate near 0 means buffer_m is set above
+    # what the arrival process can deliver)
+    async_summary: Dict[str, float] = {}
+    if asyncs:
+        fires = sum(r.get("fired", 0) for r in asyncs)
+        async_summary["ticks"] = len(asyncs)
+        async_summary["fires"] = fires
+        async_summary["fire_rate"] = fires / len(asyncs)
+        taus = [
+            r["mean_staleness"] for r in asyncs
+            if r.get("fired") and "mean_staleness" in r
+        ]
+        if taus:
+            async_summary["mean_staleness"] = sum(taus) / len(taus)
+        async_summary["max_staleness"] = max(
+            (r.get("max_staleness", 0) for r in asyncs), default=0
+        )
+        async_summary["stale_excluded"] = sum(
+            r.get("stale_excluded", 0) for r in asyncs
+        )
+
     # measured program profiles (`memory` records): cost-model flops /
     # bytes + compiled buffer budget per program, next to the analytical
     # peak_update_bytes gauge above
@@ -306,6 +332,7 @@ def summarize(records: List[dict]) -> dict:
         },
         "defense": defense_summary,
         "audit": audit_summary,
+        "async": async_summary,
         "supervisor": {"events": supervisor, "kill_reasons": kill_reasons},
     }
 
@@ -439,6 +466,13 @@ def format_table(summary: dict) -> str:
             for k, v in sorted(aud.items())
         )
         lines.append(f"audit: {pairs}")
+    asy = summary.get("async") or {}
+    if asy:
+        pairs = ", ".join(
+            f"{k}={v:.3f}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(asy.items())
+        )
+        lines.append(f"async: {pairs}")
     al = summary.get("alerts") or {}
     if al:
         sev = ", ".join(

@@ -205,6 +205,81 @@ def test_imp001_covers_run_identity_modules(tmp_path):
     ], [str(v) for v in violations]
 
 
+def test_sync001_covers_asyncfl_device_scope(tmp_path):
+    """PR 10 surface: `blades_tpu/asyncfl/` entered the SYNC001 device-code
+    scope with its traced entry points (`async_round`, the arrival `draw`,
+    `staleness_mask_weights`) as protocol roots — a host sync in any of
+    them must fire (the fire direction; HEAD silence is
+    test_tier_a_silent_on_head)."""
+    pkg = tmp_path / "blades_tpu" / "asyncfl"
+    pkg.mkdir(parents=True)
+    (pkg / "engine.py").write_text(textwrap.dedent(
+        '''\
+        """Doc. Reference counterpart: none — test module."""
+        import jax.numpy as jnp
+
+
+        def async_round(engine, state):
+            count = jnp.sum(state["buf_mask"])
+            return count.item()  # VIOLATION
+
+
+        def _helper_not_a_root(x):
+            return x.item()  # unreachable: never referenced by a root
+        '''
+    ))
+    (pkg / "arrivals.py").write_text(textwrap.dedent(
+        '''\
+        """Doc. Reference counterpart: none — test module."""
+        import numpy as np
+
+
+        class ArrivalProcess:
+            def draw(self, key, k):
+                return np.asarray(key)  # VIOLATION
+        '''
+    ))
+    violations, _ = run_rules(RepoIndex(str(tmp_path)), all_rules())
+    hits = [v for v in violations if v.rule == "SYNC001"]
+    assert sorted(v.path for v in hits) == [
+        "blades_tpu/asyncfl/arrivals.py",
+        "blades_tpu/asyncfl/engine.py",
+    ], [str(v) for v in violations]
+    assert {"async_round", "draw"} == {
+        v.message.split("jit-reachable `")[1].split("`")[0] for v in hits
+    }
+
+
+def test_imp001_rejects_asyncfl_from_prejax_contract_files(tmp_path):
+    """PR 10 surface: `blades_tpu.asyncfl` is a known jax-importing
+    module — a module-scope import of it from a pre-jax contracted file
+    (here telemetry/context.py) must fire IMP001."""
+    tel = tmp_path / "blades_tpu" / "telemetry"
+    tel.mkdir(parents=True)
+    (tel / "context.py").write_text(
+        '"""Doc. Reference counterpart: none — test module."""\n'
+        "from blades_tpu.asyncfl import AsyncConfig\n"
+    )
+    violations, _ = run_rules(RepoIndex(str(tmp_path)), all_rules())
+    assert [v.rule for v in violations] == ["IMP001"], [
+        str(v) for v in violations
+    ]
+    assert "blades_tpu.asyncfl" in violations[0].message
+
+
+def test_repo_index_scans_asyncfl():
+    """The RepoIndex scope pin: the real asyncfl modules are in the
+    lintable file set (a future roots change silently dropping them would
+    turn the whole PR-10 device surface lint-invisible)."""
+    rels = {m.rel for m in RepoIndex(REPO).files}
+    assert {
+        "blades_tpu/asyncfl/__init__.py",
+        "blades_tpu/asyncfl/arrivals.py",
+        "blades_tpu/asyncfl/buffer.py",
+        "blades_tpu/asyncfl/engine.py",
+    } <= rels
+
+
 def test_json001_covers_runs_script(tmp_path):
     """PR 9 surface: `scripts/runs.py` (the ledger query CLI) entered the
     one-JSON-line contract set — a main() without the catch-all funnel
@@ -475,21 +550,23 @@ def test_tier_b_all_invariants_hold(tier_b_result):
 
 def test_tier_b_covers_all_programs_and_invariants(tier_b_result):
     """The acceptance surface: donation, dtype, sharding-axis, and
-    retrace-stability each verified, across round, block, and streaming
-    programs."""
+    retrace-stability each verified, across round, block, streaming, and
+    buffered-async programs."""
     checks = {(c["check"], c["program"]) for c in tier_b_result["checks"]}
     kinds = {c for c, _ in checks}
     assert kinds == {
         "donation", "dtype_f64", "sharding_axis", "retrace_stability"
     }, kinds
-    for program in ("round", "block", "streaming"):
+    for program in ("round", "block", "streaming", "async"):
         assert ("donation", program) in checks
         assert ("dtype_f64", program) in checks
         assert ("retrace_stability", program) in checks
-    # the miscompile-guard axis check runs on the SHARDED trace of both
-    # round bodies
+    # the miscompile-guard axis check runs on the SHARDED trace of every
+    # body that builds a rank-2 client-axis value (both round bodies and
+    # the async buffer/lag-gather body)
     assert ("sharding_axis", "round_sharded") in checks
     assert ("sharding_axis", "streaming_sharded") in checks
+    assert ("sharding_axis", "async_sharded") in checks
 
 
 def test_tier_b_donation_detail_names_the_alias_map(tier_b_result):

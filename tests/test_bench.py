@@ -55,6 +55,25 @@ def test_tpu_headline(bench, monkeypatch, capsys):
     assert payload["vs_baseline"] is not None
 
 
+def test_async_row_labeled_non_headline(bench, monkeypatch, capsys):
+    """A buffered-async measurement (PR 10) never rides the clean headline:
+    the payload is labeled `_asyncM<m>`, vs_baseline is nulled, and the
+    async fields (buffer_m / staleness cadence) pass through."""
+    probe = ({"probe": "ok", "platform": "axon", "n_devices": 1}, None)
+    full = ({"rounds_per_sec": 3.0, "clients": 1000, "platform": "axon",
+             "async": True, "buffer_m": 500, "staleness": "polynomial",
+             "agg_fires_per_round": 0.8, "mean_staleness": 1.25}, None)
+    payload, _, code = run_main(bench, monkeypatch, capsys, [probe, full])
+    assert code == 0
+    assert payload["config"].endswith("_asyncM500")
+    assert payload["vs_baseline"] is None
+    assert payload["async"] is True
+    assert payload["buffer_m"] == 500
+    assert payload["agg_fires_per_round"] == 0.8
+    assert payload["mean_staleness"] == 1.25
+    assert payload["staleness"] == "polynomial"
+
+
 def test_full_timeout_skips_retry_and_falls_to_smoke(bench, monkeypatch, capsys):
     probe = ({"probe": "ok", "platform": "axon", "n_devices": 1}, None)
     full_to = (None, "timeout after 1500s")

@@ -86,6 +86,27 @@ def test_inertness_twin_bit_identical_tier1(tmp_path):
     np.testing.assert_array_equal(p_nan, p_inf)
 
 
+def test_async_scenario_invariants_tier1(tmp_path):
+    """Invariant 7, tier-1 slice: a buffered-async chaos scenario (seed 5
+    — every 6th seed runs FedBuff-style rounds under its fault weather)
+    completes with all invariants intact, its per-round `async` records'
+    buffer arithmetic self-consistent, AND the same scenario through
+    Simulator.run(block_size=2) lands on bit-identical final parameters
+    (the async state — buffer, versions, countdowns, lag ring — rides the
+    round-block scan like every other RoundState leaf)."""
+    scn = chaos.make_scenario(5)
+    assert scn.get("async") is not None  # scenario table pin
+    assert "straggler_rate" not in scn["fault"]  # replaced by staleness
+    log = str(tmp_path / "s5")
+    sim, params = chaos.run_scenario(scn, log)
+    violations = chaos.check_invariants(scn, log, params)
+    assert violations == []
+    ev = sim.evaluate(scn["rounds"], 64)
+    assert np.isfinite(ev["Loss"])
+    _, p_blk = chaos.run_scenario(scn, str(tmp_path / "blk"), block_size=2)
+    np.testing.assert_array_equal(params, p_blk)
+
+
 def test_block_scheduling_neutral_under_faults_tier1(tmp_path):
     """Invariant 6, tier-1 slice: the same chaos scenario run through
     Simulator.run(block_size=2) — the scanned round-block program with the

@@ -121,6 +121,28 @@ def test_trace_ingestion(tmp_path):
     assert row["compiles"] == 4 and row["peak_update_bytes"] == 5000
 
 
+def test_async_rows_ingested_non_headline():
+    """PR 10: the committed buffered-async bench rows
+    (results/asyncfl/rows.jsonl) fold into the trajectory with their
+    async fields and surface as the `async_bench` derived entry — while
+    the sync headline derived numbers are computed exactly as before
+    (async rows are labeled, never the headline)."""
+    report = perf_report.build_report(REPO, [])
+    rows = [r for r in report["rows"] if r.get("async")]
+    assert rows, "committed async rows missing from the trajectory"
+    for r in rows:
+        # child-payload rows carry the asyncM label in their row name
+        # (the parent ladder's `config` label is the other spelling)
+        assert r["name"].startswith("asyncfl/") and "asyncM" in r["name"]
+        assert r.get("buffer_m") is not None
+        assert r.get("agg_fires_per_round") is not None
+    ab = report["derived"]["async_bench"]
+    assert ab["rows"] == len(rows)
+    assert ab["best_rounds_per_sec"] > 0
+    # the sync headline gate's inputs are untouched by the async rows
+    assert report["derived"]["block_speedup"] == 2.72
+
+
 def test_committed_trajectory_artifacts_fresh():
     """The committed results/perf_report/ artifacts exist and agree with
     a fresh in-process report over the same repo (the trajectory is
