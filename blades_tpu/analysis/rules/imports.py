@@ -38,6 +38,7 @@ NO_JAX_SUFFIXES = (
     "blades_tpu/telemetry/context.py",
     "blades_tpu/telemetry/ledger.py",
     "blades_tpu/telemetry/alerts.py",
+    "blades_tpu/telemetry/timeline.py",
     "blades_tpu/supervision/__init__.py",
     "blades_tpu/supervision/__main__.py",
     "blades_tpu/supervision/heartbeat.py",

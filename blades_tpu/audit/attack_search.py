@@ -38,6 +38,7 @@ never measures aggregator breakdown (``src/blades/simulator.py:239-244``).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -48,6 +49,8 @@ from jax import lax
 from blades_tpu.aggregators.base import Aggregator
 from blades_tpu.attackers.base import honest_stats
 from blades_tpu.ops.distances import pairwise_sq_euclidean
+from blades_tpu.telemetry import recorder as _trecorder
+from blades_tpu.telemetry import timeline as _timeline
 
 TEMPLATE_NAMES = ("ipm", "alie", "signflip", "minmax", "minsum")
 
@@ -183,6 +186,7 @@ def search_cell(
     grids: Optional[dict] = None,
     part_mask: Optional[jnp.ndarray] = None,
     use_jit: bool = False,
+    cell_label: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Worst-case deviation search for one (aggregator, f) cell.
 
@@ -193,6 +197,12 @@ def search_cell(
     The aggregator is evaluated single-shot from a fresh ``init_state``
     (stateful defenses certify their first-round behavior; docs note).
 
+    Sweep accounting (``telemetry/timeline.py``): each call emits one
+    ``sweep`` record — ``cell_label`` (default ``f<f>/k<K>``), wall /
+    compile / execute split — onto the ACTIVE recorder, so a driver that
+    installed a trace (``scripts/certify.py``) gets per-cell telemetry
+    with no wiring here; with the NULL recorder the emit is a no-op.
+
     Returns ``{"templates": {name: {"worst_dev", "worst_ratio"}},
     "worst_dev", "worst_ratio", "rho"}`` — ratio is deviation over the
     per-trial max honest deviation ``rho`` (floored at 1e-9).
@@ -200,6 +210,8 @@ def search_cell(
     if trials_updates.ndim == 2:
         trials_updates = trials_updates[None]
     t, k, d = trials_updates.shape
+    _cell_t0 = time.perf_counter()
+    _cell_counters = _trecorder.process_counters()
     ctx = dict(ctx or {})
     g = dict(DEFAULT_GRIDS)
     g.update(grids or {})
@@ -263,6 +275,12 @@ def search_cell(
         }
         for i, name in enumerate(TEMPLATE_NAMES)
     }
+    _timeline.sweep_cell_event(
+        "attack_search",
+        cell_label or f"f{f}/k{k}",
+        time.perf_counter() - _cell_t0,
+        _cell_counters,
+    )
     return {
         "templates": templates,
         "worst_dev": float(devs.max()),
@@ -334,6 +352,7 @@ def search_cell_staleness(
     ctx: Optional[dict] = None,
     grids: Optional[dict] = None,
     use_jit: bool = False,
+    cell_label: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Worst-case deviation search for one (aggregator, f) cell under
     buffered-async staleness weighting (see the section comment above).
@@ -358,6 +377,7 @@ def search_cell_staleness(
     out = search_cell(
         agg, weighted, f, ctx=ctx, grids=grids, part_mask=part,
         use_jit=use_jit,
+        cell_label=cell_label or f"f{f}/k{k}/tau{tau_byz}",
     )
     out["staleness"] = {
         "mode": mode,

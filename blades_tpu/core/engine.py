@@ -53,6 +53,7 @@ from blades_tpu.ops.streaming import (
 )
 from blades_tpu.parallel.mesh import ShardingPlan
 from blades_tpu.telemetry import get_recorder
+from blades_tpu.telemetry import timeline as _timeline
 from blades_tpu.telemetry.metric_pack import (
     pack_dense,
     pack_finalize,
@@ -382,6 +383,12 @@ class RoundEngine:
         # the same jit object (at most 2 per run: full blocks + remainder)
         self._block_jit = None
         self._block_sampler = None
+        # static labels the dispatch accounting stamps on `timeline`
+        # records: which round semantics this engine's launches execute
+        self._timeline_attrs = {
+            "streaming": int(self.streaming),
+            "async": int(self.async_config is not None),
+        }
 
     def _validate_streaming(
         self, aggregator, attack, fault_model, audit_monitor, collect_diagnostics
@@ -1088,7 +1095,14 @@ class RoundEngine:
         Telemetry: the async program dispatch runs under a ``dispatch``
         span on the active recorder (``blades_tpu.telemetry``); the span
         measures trace+enqueue cost, NOT device execution — callers that
-        want the device wall time block inside their own span."""
+        want the device wall time block inside their own span. The launch
+        also opens a dispatch-accounting window
+        (``telemetry/timeline.py``): callers that block on the result
+        close it via ``timeline.launch_ready`` (the Simulator's sync span
+        does), splitting each launch into host-enqueue vs device-ready
+        time with the compile counters joined to the launch that incurred
+        them."""
+        _timeline.launch_begin("round", rounds=1, attrs=self._timeline_attrs)
         with get_recorder().span("dispatch"):
             (
                 new_state,
@@ -1107,6 +1121,7 @@ class RoundEngine:
                 jnp.asarray(server_lr, jnp.float32),
                 key,
             )
+        _timeline.launch_enqueued()
         self.last_updates = updates if self.keep_updates else None
         self.last_diagnostics = agg_diag if self.collect_diagnostics else None
         self.last_fault_diag = fault_diag if self.fault_model is not None else None
@@ -1185,6 +1200,7 @@ class RoundEngine:
             self._block_jit = self._build_block(sampler)
             self._block_sampler = sampler
         r = int(sample_keys.shape[0])
+        _timeline.launch_begin("block", rounds=r, attrs=self._timeline_attrs)
         with get_recorder().span("dispatch", rounds=r):
             new_state, (
                 metrics, agg_diag, fault_diag, audit_diag, mpacks, adiags,
@@ -1197,6 +1213,7 @@ class RoundEngine:
                     key,
                 )
             )
+        _timeline.launch_enqueued()
         last = lambda tree: jax.tree_util.tree_map(lambda a: a[-1], tree)
         self.last_updates = None
         self.last_diagnostics = last(agg_diag) if self.collect_diagnostics else None

@@ -44,6 +44,7 @@ from blades_tpu.server import BladesServer
 from blades_tpu.supervision import heartbeat as _heartbeat
 from blades_tpu.telemetry import Recorder, install_jax_monitoring, set_recorder
 from blades_tpu.telemetry import alerts as _alerts
+from blades_tpu.telemetry import timeline as _timeline
 from blades_tpu.telemetry import context as _context
 from blades_tpu.telemetry import ledger as _ledger
 from blades_tpu.telemetry import profiling as _profiling
@@ -549,6 +550,9 @@ class Simulator:
         self.telemetry = rec
         set_recorder(rec)  # engine spans + jax compile events land here
         install_jax_monitoring()
+        # dispatch accounting (telemetry/timeline.py): a previous run's
+        # unemitted launch splits must not leak into this run's round 1
+        _timeline.reset()
         # anomaly alerting (telemetry/alerts.py): rule evaluation rides the
         # records the run already emits at the existing flush cadence; a
         # critical alert (non-finite/diverging loss) can recycle a
@@ -783,6 +787,14 @@ class Simulator:
                             # here (log_train's float() conversions used to
                             # absorb it)
                             jax.block_until_ready(m)
+                        # close the dispatch-accounting window: ready time
+                        # is measured from dispatch-return to here (NOT the
+                        # bare block call) — on the 1-core box the executor
+                        # preempts the host thread, so execution wall lands
+                        # on whatever host line runs next, and only the
+                        # full enqueue->blocked window attributes it
+                        # honestly to the device side
+                        _timeline.launch_ready()
                         self.log_train(rnd, local_steps, m)
                         self.log_variance(rnd, m)
                         self._log_defense(rnd)
@@ -839,6 +851,9 @@ class Simulator:
                     # measured allocator watermarks (no-op on backends
                     # without memory_stats) ride the round record's gauges
                     _profiling.record_live_bytes(rec)
+                    # dispatch accounting: one aggregated `timeline` record
+                    # per launch kind, joining this round's single flush
+                    _timeline.emit(rec, round_idx=rnd)
                     # per-round summary + the round's single buffered trace write
                     rec.round_record(
                         rnd,
@@ -995,6 +1010,8 @@ class Simulator:
                 with rec.span("sync"):
                     # device execution of the whole async block lands here
                     jax.block_until_ready(ms)
+                # enqueue-return -> blocked window (see the per-round loop)
+                _timeline.launch_ready()
                 for i, r in enumerate(rounds):
                     mi = slice_round(ms, i)
                     self.log_train(r, local_steps, mi)
@@ -1052,6 +1069,9 @@ class Simulator:
             # allocator watermarks at the block boundary (the streaming/
             # block flush point) — no-op without backend memory_stats
             _profiling.record_live_bytes(rec)
+            # dispatch accounting: one `timeline` record per block
+            # boundary, joining the block's single flush below
+            _timeline.emit(rec, round_idx=rounds[-1])
             for i, r in enumerate(rounds):
                 round_times.append(wall / bs)
                 # per-round summaries (amortized wall), ONE buffered trace

@@ -307,6 +307,22 @@ _JAX_EVENT_COUNTERS = {
     "/jax/compilation_cache/cache_misses": "xla.cache_misses",
 }
 
+#: Process-wide cumulative mirror of the jax.monitoring counters above —
+#: fed by the listeners regardless of which Recorder is active (or whether
+#: any is). The dispatch/sweep accounting layer (``telemetry/timeline.py``)
+#: snapshots/deltas this dict to join compiles to the launch or sweep cell
+#: that incurred them: recorder swaps (one Recorder per sweep scenario)
+#: would otherwise tear the join. Dict ops only, no I/O — the
+#: disabled-recorder zero-syscall contract is untouched, and compile
+#: events are rare by construction.
+_PROCESS_COUNTERS: Dict[str, float] = {}
+
+
+def process_counters() -> Dict[str, float]:
+    """Snapshot of the process-wide compile/cache counters (cumulative
+    since :func:`install_jax_monitoring`; empty before it)."""
+    return dict(_PROCESS_COUNTERS)
+
 # jax.monitoring duration event -> (count counter | None, seconds counter)
 _JAX_DURATION_COUNTERS = {
     "/jax/core/compile/backend_compile_duration": ("xla.compiles", "xla.compile_s"),
@@ -338,16 +354,24 @@ def install_jax_monitoring() -> bool:
     def _on_event(event: str, **kw) -> None:
         name = _JAX_EVENT_COUNTERS.get(event)
         if name is not None:
+            _PROCESS_COUNTERS[name] = _PROCESS_COUNTERS.get(name, 0) + 1
             get_recorder().counter(name)
 
     def _on_duration(event: str, duration: float, **kw) -> None:
         mapped = _JAX_DURATION_COUNTERS.get(event)
         if mapped is None:
             return
+        count_name, secs_name = mapped
+        if count_name is not None:
+            _PROCESS_COUNTERS[count_name] = (
+                _PROCESS_COUNTERS.get(count_name, 0) + 1
+            )
+        _PROCESS_COUNTERS[secs_name] = (
+            _PROCESS_COUNTERS.get(secs_name, 0) + duration
+        )
         rec = get_recorder()
         if not rec.enabled:
             return
-        count_name, secs_name = mapped
         if count_name is not None:
             rec.counter(count_name)
         rec.counter(secs_name, duration)

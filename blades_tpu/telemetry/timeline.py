@@ -1,0 +1,378 @@
+"""Dispatch & sweep accounting: where does the wall-clock actually go?
+
+The repo's scale claims (ROADMAP items 2-4: experiment-axis vmap,
+streaming K→10^6, fused Pallas kernels) all rest on the assertion that
+large-K rounds and the cert/chaos/attack sweeps are *dispatch-bound* —
+but until this module that assertion was inferred from one PR 5 block
+measurement: the ``round/dispatch`` span lumps host enqueue, trace/
+lower/compile, and device execute into one number, and the sweep drivers
+run thousands of sequential cells emitting zero per-cell telemetry.
+This module is the instrument that says *which* component of wall-clock
+a scaling PR must beat:
+
+**Launch accounting** (``launch_begin`` / ``launch_enqueued`` /
+``launch_ready`` / ``emit``): splits every XLA program dispatch into
+
+- ``enqueue_s`` — host time until the async dispatch call returns (the
+  jitted call's own wall: argument handling + trace/lower/compile on a
+  cold launch + enqueue);
+- ``ready_s`` — the dispatch-return → ``block_until_ready``-return
+  window (device execution plus whatever the runtime had not finished at
+  enqueue return). Measured across the whole window rather than the bare
+  block call on purpose: on a single-core host the XLA executor preempts
+  the Python thread the moment the dispatch returns, so execution wall
+  lands on whichever host line runs next — only the full window
+  attributes it honestly to the device side (measured: the bare block
+  read 0.1 ms while ~3 s of execution stalled a plain attribute
+  assignment);
+
+and joins the ``jax.monitoring`` compile/cache counters (via the
+process-wide mirror :func:`~blades_tpu.telemetry.recorder
+.process_counters` — recorder swaps cannot tear the join) to the launch
+that incurred them. Launches fold into an in-memory accumulator keyed by
+launch kind; :func:`emit` turns the accumulated splits into one
+``timeline`` record per kind at the run's EXISTING flush cadence
+(``Simulator`` calls it right before each ``round_record``), so the
+flush-once-per-round discipline is untouched. ``dispatch_share`` =
+``enqueue_s / (enqueue_s + ready_s)``: the fraction of a round's
+launch wall the host spends before the device even has the work — the
+number the streaming/vmap PRs must visibly reduce.
+
+**Sweep accounting** (:class:`SweepAccounting`): per-cell records for
+the long sequential sweep drivers (``scripts/certify.py``,
+``scripts/chaos.py``, the ``audit.attack_search`` cells). Each completed
+cell emits one ``sweep`` record — cell key, wall / compile / execute
+split, progress ``i``-of-``total``, ETA — flushed at the cell boundary
+(a cell is the sweep's "round") to the sweep's OWN file-backed recorder,
+so the trace survives the per-scenario recorder swaps the drivers
+perform, and is queryable LIVE by ``scripts/sweep_status.py`` and
+``scripts/runs.py --run-id``. The cell boundary also beats the
+supervision heartbeat (``BLADES_HEARTBEAT_FILE``), so a long sweep under
+``python -m blades_tpu.supervision`` cannot false-trip the staleness
+watchdog between Simulator flushes.
+
+Like ``context.py``/``recorder.py``, this module is stdlib-only and
+importable before jax (IMP001-contracted): every measurement is a
+``time.perf_counter`` read plus dict arithmetic; anything jax-touching
+stays at the call sites (``core/engine.py``, the drivers). Disabled
+telemetry (``BLADES_TELEMETRY=0``) reduces every hook to an attribute
+check and an early return — zero clock reads, zero records, zero added
+compiles (pinned in ``tests/test_timeline.py``).
+
+Record schemas: ``docs/telemetry_schema.json`` v3 (``timeline``,
+``sweep``); prose in ``docs/observability.md`` "Dispatch accounting".
+Reference counterpart: none — the reference records only whole-round
+wall time (``src/blades/simulator.py:453-455``); it cannot say whether a
+slow round is host- or device-bound.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from blades_tpu.telemetry import recorder as _recorder
+
+#: process-counter keys joined to each launch/cell, and their short names
+#: in the emitted records
+_COUNTER_FIELDS = (
+    ("xla.compiles", "compiles"),
+    ("xla.compile_s", "compile_s"),
+    ("xla.trace_s", "trace_s"),
+    ("xla.cache_hits", "cache_hits"),
+    ("xla.cache_misses", "cache_misses"),
+)
+
+#: count-like record fields emitted as ints (the rest stay seconds)
+_INT_FIELDS = frozenset({"compiles", "cache_hits", "cache_misses"})
+
+
+def _counter_delta(before: Dict[str, float]) -> Dict[str, float]:
+    """Per-launch/cell compile+cache counter deltas vs a snapshot."""
+    now = _recorder.process_counters()
+    out: Dict[str, float] = {}
+    for key, short in _COUNTER_FIELDS:
+        d = now.get(key, 0) - before.get(key, 0)
+        if d:
+            out[short] = int(d) if short in _INT_FIELDS else d
+    return out
+
+
+# -- launch accounting ---------------------------------------------------------
+
+
+class _Launch:
+    """One in-flight XLA program dispatch (single-threaded: at most one)."""
+
+    __slots__ = ("kind", "rounds", "attrs", "t0", "t_enqueued", "counters0")
+
+    def __init__(self, kind: str, rounds: int, attrs: Optional[dict]):
+        self.kind = kind
+        self.rounds = int(rounds)
+        self.attrs = dict(attrs or {})
+        self.t0 = time.perf_counter()
+        self.t_enqueued: Optional[float] = None
+        self.counters0 = _recorder.process_counters()
+
+
+_open_launch: Optional[_Launch] = None
+
+#: kind -> accumulated splits since the last :func:`emit`
+_acc: Dict[str, Dict[str, Any]] = {}
+
+
+def launch_begin(kind: str, rounds: int = 1,
+                 attrs: Optional[dict] = None) -> None:
+    """Open a launch window right before an XLA program dispatch.
+
+    ``kind`` labels the program family (``round`` / ``block``); ``rounds``
+    is how many federated rounds the launch executes (a block amortizes);
+    ``attrs`` are static labels copied onto the emitted record (e.g.
+    ``{"streaming": 1}``). No-op when the active recorder is disabled.
+    A launch still open from a caller that never synced (e.g. a bench
+    loop measuring only enqueue) folds with ``ready_s = 0`` — we never
+    observed its device wait, so we do not invent one.
+    """
+    global _open_launch
+    if not _recorder.get_recorder().enabled:
+        return
+    if _open_launch is not None:
+        _fold(_open_launch, 0.0)
+    _open_launch = _Launch(kind, rounds, attrs)
+
+
+def launch_enqueued() -> None:
+    """Mark the dispatch call's return (host enqueue complete)."""
+    launch = _open_launch
+    if launch is not None:
+        launch.t_enqueued = time.perf_counter()
+
+
+def launch_ready(ready_s: Optional[float] = None) -> None:
+    """Close the open launch after ``block_until_ready`` returned.
+
+    ``ready_s``: the caller's measured block delta (preferred — the
+    simulator times exactly the ``block_until_ready`` call); when omitted,
+    now-minus-enqueue-return is used.
+    """
+    global _open_launch
+    launch = _open_launch
+    if launch is None:
+        return
+    _open_launch = None
+    _fold(launch, ready_s)
+
+
+def _fold(launch: _Launch, ready_s: Optional[float]) -> None:
+    now = time.perf_counter()
+    enq_end = launch.t_enqueued if launch.t_enqueued is not None else now
+    enqueue_s = max(0.0, enq_end - launch.t0)
+    if ready_s is None:
+        ready_s = max(0.0, now - enq_end)
+    acc = _acc.setdefault(
+        launch.kind,
+        {"launches": 0, "rounds": 0, "enqueue_s": 0.0, "ready_s": 0.0,
+         "attrs": {}},
+    )
+    acc["launches"] += 1
+    acc["rounds"] += launch.rounds
+    acc["enqueue_s"] += enqueue_s
+    acc["ready_s"] += ready_s
+    acc["attrs"].update(launch.attrs)
+    for short, d in _counter_delta(launch.counters0).items():
+        acc[short] = acc.get(short, 0) + d
+
+
+def emit(rec=None, round_idx: Optional[int] = None) -> None:
+    """Emit one aggregated ``timeline`` record per launch kind folded
+    since the previous emit, onto ``rec`` (default: the active recorder).
+
+    Called at the run's existing flush cadence — the Simulator calls it
+    right before each ``round_record`` (per round, or per block boundary)
+    — so accounting adds records to the SAME buffered batch, never an
+    extra flush. Clears the accumulator either way.
+    """
+    global _acc
+    acc, _acc = _acc, {}
+    rec = rec if rec is not None else _recorder.get_recorder()
+    if not rec.enabled:
+        return
+    for kind, a in acc.items():
+        total = a["enqueue_s"] + a["ready_s"]
+        fields: Dict[str, Any] = {
+            "kind": kind,
+            "launches": a["launches"],
+            "rounds": a["rounds"],
+            "enqueue_s": round(a["enqueue_s"], 6),
+            "ready_s": round(a["ready_s"], 6),
+            "dispatch_share": round(a["enqueue_s"] / total, 6) if total else 0.0,
+        }
+        if round_idx is not None:
+            fields["round"] = int(round_idx)
+        for _, short in _COUNTER_FIELDS:
+            if short in a:
+                fields[short] = (
+                    a[short] if short in _INT_FIELDS else round(a[short], 6)
+                )
+        fields.update(a["attrs"])
+        rec.event("timeline", **fields)
+
+
+def reset() -> None:
+    """Drop any accumulated-but-unemitted launch state (run start: a
+    previous run's leftovers must not leak into round 1's record)."""
+    global _open_launch, _acc
+    _open_launch = None
+    _acc = {}
+
+
+# -- sweep accounting ----------------------------------------------------------
+
+
+class SweepAccounting:
+    """Per-cell accounting for a long sequential sweep driver.
+
+    Owns its OWN file-backed :class:`~blades_tpu.telemetry.recorder
+    .Recorder` (``path``): the sweep drivers construct one Simulator per
+    scenario, each of which installs its own global recorder — the
+    sweep's trace must survive those swaps. Each completed cell emits one
+    ``sweep`` record and flushes (the cell boundary is the sweep's
+    "round"; cells run seconds-to-minutes, so one buffered write each is
+    the existing once-per-round discipline, not a hot path) and beats the
+    supervision heartbeat so a supervised sweep stays visibly alive
+    between Simulator flushes.
+
+    Usage::
+
+        sw = SweepAccounting("certify", total=n_cells, path=trace_path)
+        for ...:
+            with sw.cell(f"{agg}/f{f}"):
+                ...   # one cell's work
+        sw.close()
+    """
+
+    def __init__(
+        self,
+        kind: str,
+        total: int,
+        path: Optional[str] = None,
+        meta: Optional[dict] = None,
+    ):
+        self.kind = kind
+        self.total = int(total)
+        self.done = 0
+        self._t0 = time.perf_counter()
+        self.rec = _recorder.Recorder(
+            path=path,
+            meta={"run": "sweep", "sweep": kind, "cells_total": int(total),
+                  **(meta or {})},
+        )
+        # best-effort: the per-cell compile join needs the jax.monitoring
+        # listeners; a no-op before jax is importable (sweeps import it
+        # anyway), so this module stays importable pre-jax
+        _recorder.install_jax_monitoring()
+        # create the trace file NOW: a sweep killed in cell 0's compile
+        # must still be queryable by sweep_status
+        self.rec.flush()
+
+    def cell(self, key: str, **fields):
+        """Context manager accounting one sweep cell (``fields`` are extra
+        static labels copied onto the record, schema-permitting)."""
+        return _Cell(self, str(key), fields)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "sweep": self.kind,
+            "cells": self.done,
+            "total": self.total,
+            "wall_s": round(time.perf_counter() - self._t0, 3),
+        }
+
+    def close(self) -> None:
+        self.rec.close()
+
+
+class _Cell:
+    __slots__ = ("_sw", "_key", "_fields", "_t0", "_counters0")
+
+    def __init__(self, sw: SweepAccounting, key: str, fields: dict):
+        self._sw = sw
+        self._key = key
+        self._fields = fields
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._counters0 = _recorder.process_counters()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        sw = self._sw
+        wall = time.perf_counter() - self._t0
+        delta = _counter_delta(self._counters0)
+        sw.done += 1
+        rate = (time.perf_counter() - sw._t0) / max(sw.done, 1)
+        rec_fields: Dict[str, Any] = {
+            "sweep": sw.kind,
+            "cell": self._key,
+            "ts": time.time(),
+            "i": sw.done,
+            "total": sw.total,
+            "wall_s": round(wall, 6),
+            "eta_s": round(max(0.0, rate * (sw.total - sw.done)), 1),
+            # execute_s approximates the non-build share of the cell: wall
+            # minus trace+compile. Host dispatch overhead is inside it —
+            # the launch accounting (timeline records) owns that split.
+            "execute_s": round(
+                max(0.0, wall - delta.get("compile_s", 0.0)
+                    - delta.get("trace_s", 0.0)), 6,
+            ),
+            **delta,
+            **self._fields,
+        }
+        if exc_type is not None:
+            rec_fields["ok"] = False
+            rec_fields["error"] = f"{exc_type.__name__}: {exc}"[:300]
+        sw.rec.event("sweep", **rec_fields)
+        # cell boundary: one buffered trace write + one heartbeat touch —
+        # a supervised sweep's liveness signal between Simulator flushes
+        sw.rec.flush()
+        try:
+            from blades_tpu.supervision import heartbeat as _heartbeat
+
+            _heartbeat.beat(round_idx=sw.done)
+        except Exception:  # noqa: BLE001 - accounting must never kill a sweep
+            pass
+        return False
+
+
+def sweep_cell_event(
+    sweep: str,
+    cell: str,
+    wall_s: float,
+    counters_before: Dict[str, float],
+    rec=None,
+    **fields,
+) -> None:
+    """Emit one ``sweep`` record for an externally-timed cell onto the
+    ACTIVE recorder (no flush — the owner controls the cadence). Used by
+    library-level sweep units (``audit.attack_search.search_cell``) whose
+    driver may or may not be a :class:`SweepAccounting` owner; with the
+    NULL recorder active this is a no-op, so tests and ad-hoc calls pay
+    nothing."""
+    rec = rec if rec is not None else _recorder.get_recorder()
+    if not rec.enabled:
+        return
+    delta = _counter_delta(counters_before)
+    rec.event(
+        "sweep",
+        sweep=sweep,
+        cell=cell,
+        ts=time.time(),
+        wall_s=round(wall_s, 6),
+        execute_s=round(
+            max(0.0, wall_s - delta.get("compile_s", 0.0)
+                - delta.get("trace_s", 0.0)), 6,
+        ),
+        **delta,
+        **fields,
+    )

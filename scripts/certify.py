@@ -81,7 +81,18 @@ def build_aggregator(name: str, k: int, f: int):
     return get_aggregator(base, **kwargs)
 
 
-def certify_matrix(args) -> dict:
+def total_cells(args) -> int:
+    """Upfront cell count for the sweep accounting's i-of-N / ETA: one
+    contract-battery cell per aggregator, one breakdown cell per
+    (aggregator, f), and two staleness scenarios per breakdown cell
+    unless ``--no-async``."""
+    names = tuple(args.aggs) if args.aggs else CERT_POOL
+    f_cells = (args.clients - 1) // 2 + 1
+    per_f = 1 + (0 if args.no_async else 2)
+    return len(names) * (1 + f_cells * per_f)
+
+
+def certify_matrix(args, sweep=None) -> dict:
     import jax
 
     from blades_tpu.aggregators import get_aggregator
@@ -108,16 +119,31 @@ def certify_matrix(args) -> dict:
     trials_updates = synthetic_honest(key, trials, k, d)
     ctx = battery_ctx(None, k, d, key=jax.random.fold_in(key, 1))
 
+    # sweep accounting (telemetry/timeline.py): every cell below runs
+    # inside `sweep.cell(...)` — one per-cell `sweep` record (wall/compile/
+    # execute split, i-of-N, ETA) flushed at the cell boundary, plus a
+    # heartbeat touch so a supervised sweep stays visibly alive. A None
+    # sweep (library callers, tests) degrades to a no-op context.
+    if sweep is None:
+        from contextlib import nullcontext
+
+        class _NullSweep:
+            def cell(self, key_, **kw):
+                return nullcontext()
+
+        sweep = _NullSweep()
+
     battery, cells, async_cells = {}, [], []
     for name in names:
         base, _, _ = name.partition(":")
         f_nom = nominal_f(base, k)
         # -- contract battery at f = max(1, nominal) --------------------------
         agg = build_aggregator(name, k, max(1, f_nom))
-        res = run_battery(
-            agg, k=k, d=d, f=max(1, f_nom), name=base, c=c, trials=trials,
-            seed=args.seed, grids=grids, use_jit=not args.no_jit,
-        )
+        with sweep.cell(f"battery/{name}"):
+            res = run_battery(
+                agg, k=k, d=d, f=max(1, f_nom), name=base, c=c, trials=trials,
+                seed=args.seed, grids=grids, use_jit=not args.no_jit,
+            )
         # read opt-outs from the INSTANCE: configuration-dependent defenses
         # shadow the class dict with the variant's own set (clustering's
         # metric='distance' drops the similarity-specific resilience
@@ -139,10 +165,12 @@ def certify_matrix(args) -> dict:
         for f in range(f_max + 1):
             agg_f = build_aggregator(name, k, f)
             t0 = time.time()
-            cell = search_cell(
-                agg_f, trials_updates, f, ctx=ctx, grids=grids,
-                use_jit=not args.no_jit,
-            )
+            with sweep.cell(f"{name}/f{f}"):
+                cell = search_cell(
+                    agg_f, trials_updates, f, ctx=ctx, grids=grids,
+                    use_jit=not args.no_jit,
+                    cell_label=f"{name}/f{f}",
+                )
             cells.append({
                 "agg": name,
                 "f": f,
@@ -166,12 +194,14 @@ def certify_matrix(args) -> dict:
                 ("fresh_byz", 0), ("stale_byz", args.tau_max),
             ):
                 t0 = time.time()
-                acell = search_cell_staleness(
-                    agg_f, trials_updates, f,
-                    mode="polynomial", alpha=0.5,
-                    tau_max=args.tau_max, tau_byz=tau_byz,
-                    ctx=ctx, grids=grids, use_jit=not args.no_jit,
-                )
+                with sweep.cell(f"{name}/f{f}/{scenario}"):
+                    acell = search_cell_staleness(
+                        agg_f, trials_updates, f,
+                        mode="polynomial", alpha=0.5,
+                        tau_max=args.tau_max, tau_byz=tau_byz,
+                        ctx=ctx, grids=grids, use_jit=not args.no_jit,
+                        cell_label=f"{name}/f{f}/{scenario}",
+                    )
                 async_cells.append({
                     "agg": name,
                     "f": f,
@@ -292,8 +322,27 @@ def main() -> int:
     # evidence artifact — make the run that produced it addressable
     from blades_tpu.telemetry import context as _context
     from blades_tpu.telemetry import ledger as _ledger
+    from blades_tpu.telemetry import set_recorder
+    from blades_tpu.telemetry import timeline as _timeline
 
     _context.activate(fresh=True)
+    # sweep accounting: per-cell telemetry to <out>/sweep_trace.jsonl,
+    # registered as a STARTED artifact so `runs.py --run-id` and
+    # `sweep_status.py` can watch the sweep live, not just post-mortem
+    sweep_trace = os.path.join(args.out, "sweep_trace.jsonl")
+    try:
+        os.unlink(sweep_trace)  # a fresh sweep is a new trace
+    except OSError:
+        pass
+    sweep = _timeline.SweepAccounting(
+        "certify", total=total_cells(args), path=sweep_trace,
+        meta={"clients": args.clients, "dim": args.dim,
+              "quick": bool(args.quick)},
+    )
+    # the sweep recorder doubles as the ACTIVE recorder: attack_search's
+    # own per-cell `sweep` records and the jax compile counters land in
+    # the same trace (restored on the way out — in-process callers, tests)
+    prev_recorder = set_recorder(sweep.rec)
     ledger_entry = _ledger.run_started(
         "certify",
         config={
@@ -305,13 +354,14 @@ def main() -> int:
             "quick": bool(args.quick),
             "aggs": sorted(args.aggs) if args.aggs else None,
         },
+        artifacts=[os.path.relpath(sweep_trace, REPO)],
     )
     try:
         from blades_tpu.utils.platform import apply_env_platform
 
         apply_env_platform()
         t0 = time.time()
-        matrix = certify_matrix(args)
+        matrix = certify_matrix(args, sweep=sweep)
         matrix["wall_s"] = round(time.time() - t0, 1)
         os.makedirs(args.out, exist_ok=True)
         artifact = os.path.join(args.out, "cert_matrix.json")
@@ -336,6 +386,8 @@ def main() -> int:
             "artifact": os.path.relpath(artifact, REPO),
             "ok": matrix["ok"],
         }
+        summary["sweep_cells"] = sweep.done
+        summary["sweep_trace"] = os.path.relpath(sweep_trace, REPO)
         ledger_entry.ended(
             "finished",
             metrics={
@@ -343,7 +395,7 @@ def main() -> int:
                 "certified_cells": summary["certified_cells"],
                 "ok": summary["ok"],
             },
-            artifacts=[summary["artifact"]],
+            artifacts=[summary["artifact"], summary["sweep_trace"]],
         )
         print(json.dumps(summary))
         return 0 if matrix["ok"] else 1
@@ -357,6 +409,9 @@ def main() -> int:
             "error": f"{type(e).__name__}: {e}"[:1000],
         }))
         return 1
+    finally:
+        set_recorder(prev_recorder)
+        sweep.close()
 
 
 if __name__ == "__main__":

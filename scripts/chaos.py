@@ -383,50 +383,70 @@ def max_dev_ratio(log_path: str):
 # -- sweep (the evidence artifact) --------------------------------------------
 
 
-def sweep(n: int, out_dir: str) -> dict:
+def sweep(n: int, out_dir: str, accounting=None) -> dict:
     """Run scenarios 0..n-1 (+ inertness twins) in-process; returns the
-    summary dict (also printed as one JSON line by ``main``)."""
+    summary dict (also printed as one JSON line by ``main``).
+
+    ``accounting``: a :class:`blades_tpu.telemetry.timeline
+    .SweepAccounting` — each seed (scenario + its twin/block reruns) is
+    one sweep cell: per-cell wall/compile split, i-of-N, ETA in the sweep
+    trace, a flush + heartbeat touch at every cell boundary (a supervised
+    sweep cannot false-trip the staleness watchdog between Simulator
+    flushes). ``None`` (library callers, tests) runs unaccounted."""
+    from contextlib import nullcontext
+
     import numpy as np
 
     results, violations = [], []
     for seed in range(n):
         scn = make_scenario(seed)
         log = os.path.join(out_dir, f"s{seed:03d}")
-        sim, params = run_scenario(scn, log)
-        v = check_invariants(scn, log, params)
-        ev = sim.evaluate(scn["rounds"], 64)
-        if not np.isfinite(ev["Loss"]):
-            v.append("non-finite eval loss")
-        twin = inertness_variant(scn)
-        if twin is not None:
-            _, params2 = run_scenario(twin, os.path.join(out_dir, f"s{seed:03d}_twin"))
-            if not np.array_equal(params, params2):
-                v.append("nan<->inf content swap changed final params")
-        # round-block slice: every 8th scenario reruns through
-        # Simulator.run(block_size=2) — the scanned round program with the
-        # sampler fused in, composed with this scenario's fault weather and
-        # the record-only audit — and must land on bit-identical params
-        # (blocks are a pure scheduling choice; 3 rounds at block 2 also
-        # exercises the remainder block)
-        block_checked = seed % 8 == 2
-        if block_checked:
-            _, params_blk = run_scenario(
-                scn, os.path.join(out_dir, f"s{seed:03d}_blk"), block_size=2
-            )
-            if not np.array_equal(params, params_blk):
-                v.append("block_size=2 changed final params")
-        results.append({
-            "seed": seed, "agg": scn["agg"], "attack": scn["attack"],
-            "async": scn.get("async"),
-            "fault": {k: ("schedule" if k == "participation_schedule" else val)
-                      for k, val in scn["fault"].items()},
-            "loss": round(float(ev["Loss"]), 4),
-            "max_dev_ratio": max_dev_ratio(log),
-            "twin_checked": twin is not None,
-            "block_checked": block_checked,
-            "violations": v,
-        })
-        violations.extend(f"seed {seed}: {msg}" for msg in v)
+        cell_cm = (
+            accounting.cell(f"s{seed:03d}/{scn['agg']}")
+            if accounting is not None
+            else nullcontext()
+        )
+        with cell_cm:
+            sim, params = run_scenario(scn, log)
+            v = check_invariants(scn, log, params)
+            ev = sim.evaluate(scn["rounds"], 64)
+            if not np.isfinite(ev["Loss"]):
+                v.append("non-finite eval loss")
+            twin = inertness_variant(scn)
+            if twin is not None:
+                _, params2 = run_scenario(
+                    twin, os.path.join(out_dir, f"s{seed:03d}_twin")
+                )
+                if not np.array_equal(params, params2):
+                    v.append("nan<->inf content swap changed final params")
+            # round-block slice: every 8th scenario reruns through
+            # Simulator.run(block_size=2) — the scanned round program with
+            # the sampler fused in, composed with this scenario's fault
+            # weather and the record-only audit — and must land on
+            # bit-identical params (blocks are a pure scheduling choice; 3
+            # rounds at block 2 also exercises the remainder block)
+            block_checked = seed % 8 == 2
+            if block_checked:
+                _, params_blk = run_scenario(
+                    scn, os.path.join(out_dir, f"s{seed:03d}_blk"),
+                    block_size=2,
+                )
+                if not np.array_equal(params, params_blk):
+                    v.append("block_size=2 changed final params")
+            results.append({
+                "seed": seed, "agg": scn["agg"], "attack": scn["attack"],
+                "async": scn.get("async"),
+                "fault": {
+                    k: ("schedule" if k == "participation_schedule" else val)
+                    for k, val in scn["fault"].items()
+                },
+                "loss": round(float(ev["Loss"]), 4),
+                "max_dev_ratio": max_dev_ratio(log),
+                "twin_checked": twin is not None,
+                "block_checked": block_checked,
+                "violations": v,
+            })
+            violations.extend(f"seed {seed}: {msg}" for msg in v)
     return {
         "metric": "chaos_scenarios",
         "scenarios": n,
@@ -514,18 +534,33 @@ def main() -> int:
     n = args.sweep if args.sweep is not None else 24
     from blades_tpu.telemetry import context as _context
     from blades_tpu.telemetry import ledger as _ledger
+    from blades_tpu.telemetry import timeline as _timeline
     from blades_tpu.utils.platform import apply_env_platform
 
     _context.activate(fresh=True)
+    # sweep accounting: one cell per seed in <out>/sweep_trace.jsonl,
+    # registered as a STARTED artifact so the sweep is watchable live
+    # (scripts/sweep_status.py, scripts/runs.py --run-id)
+    sweep_trace = os.path.join(args.out, "sweep_trace.jsonl")
+    try:
+        os.unlink(sweep_trace)  # a fresh sweep is a new trace
+    except OSError:
+        pass
+    accounting = _timeline.SweepAccounting(
+        "chaos", total=n, path=sweep_trace,
+    )
     ledger_entry = _ledger.run_started(
         "chaos", config={"kind": "chaos", "scenarios": n},
+        artifacts=[os.path.relpath(sweep_trace, REPO)],
     )
     apply_env_platform()
     try:
-        summary = sweep(n, args.out)
+        summary = sweep(n, args.out, accounting=accounting)
     except Exception as e:
         ledger_entry.ended("crashed", error=f"{type(e).__name__}: {e}")
         raise
+    finally:
+        accounting.close()
     ledger_entry.ended(
         "finished",
         metrics={
@@ -534,6 +569,7 @@ def main() -> int:
             "ok": summary["ok"],
         },
     )
+    summary["sweep_trace"] = os.path.relpath(sweep_trace, REPO)
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1
 

@@ -1,0 +1,213 @@
+"""Measured dispatch-accounting baseline (results/dispatch/).
+
+Produces the committed before-numbers the ROADMAP's scale arc
+(experiment-axis vmap + sweep server, streaming K→10^6, fused kernels)
+is gated against by ``scripts/perf_report.py --check``:
+
+1. **CPU streaming K-ladder** (K = 10^2, 10^3, 10^4; one virtual CPU
+   device — the 8-device SPMD partitioner compile is the documented
+   pathology, ``scripts/baseline_rows_cpu.py``): a short streaming
+   Simulator run per K; the per-round ``timeline`` records
+   (``blades_tpu/telemetry/timeline.py``) split every launch into
+   host-enqueue vs device-ready time. The WARM rounds (round 1 carries
+   the cold compile and is excluded) give ``dispatch_share`` — the
+   fraction of launch wall the host spends before the device has the
+   work — per K: the claim "large-K rounds are dispatch-bound" becomes
+   a measured row instead of an inference from PR 5's block speedup.
+
+2. **Cert-sweep slice** (``scripts/certify.py --quick`` subprocess over
+   a 3-aggregator pool): the sweep's per-cell ``sweep`` records give
+   ``per_cell_overhead_s`` — the mean per-cell program-build overhead
+   (trace+compile; the cost an experiment-axis-vmapped sweep amortizes
+   away) and ``mean_cell_s``.
+
+Output: ``results/dispatch/rows.jsonl`` (ingested by perf_report as
+``dispatch/<name>`` rows, gated via the ``dispatch_share_abs`` /
+``per_cell_overhead_frac`` thresholds), the cert slice's own artifacts
+under ``results/dispatch/cert_slice/``, and a README.
+
+Usage::
+
+    python scripts/dispatch_baseline.py [--rounds 4] [--ks 100 1000 10000]
+
+Reference counterpart: none — the reference publishes no numbers at all
+(BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "results", "dispatch")
+ROWS = os.path.join(OUT, "rows.jsonl")
+
+CERT_SLICE_AGGS = ("mean", "median", "trimmedmean")
+
+
+def ladder_row(k: int, rounds: int, log_root: str) -> dict:
+    """One streaming K row: run, then read the run's own telemetry."""
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import Synthetic
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from trace_summary import load_records, summarize
+
+    log = os.path.join(log_root, f"k{k}")
+    chunks = max(1, k // 100)  # [<=100, D] slabs, K-independent peak
+    sim = Simulator(
+        dataset=Synthetic(
+            num_clients=k, train_size=2 * k, test_size=64, noise=0.3,
+            cache=False,
+        ),
+        aggregator="trimmedmean",
+        aggregator_kws={"num_byzantine": 1},
+        log_path=log,
+        seed=0,
+    )
+    sim.run(
+        "mlp", global_rounds=rounds, local_steps=1, train_batch_size=2,
+        client_lr=0.2, validate_interval=rounds + 1,  # never: dispatch only
+        streaming=True, client_chunks=chunks,
+    )
+    records = load_records(os.path.join(log, "telemetry.jsonl"))
+    summary = summarize(records)
+    # warm rounds only: round 1 is the cold compile
+    warm_tl = [
+        r for r in records
+        if r.get("t") == "timeline" and r.get("round", 0) >= 2
+    ]
+    warm_rounds = [
+        r for r in records if r.get("t") == "round" and r["round"] >= 2
+    ]
+    enq = sum(r["enqueue_s"] for r in warm_tl)
+    rdy = sum(r["ready_s"] for r in warm_tl)
+    n = max(len(warm_rounds), 1)
+    wall = sum(r.get("wall_s", 0.0) for r in warm_rounds)
+    return {
+        "name": f"k{k}_stream",
+        "clients": k,
+        "streaming": True,
+        "client_chunks": chunks,
+        "dim": sim.engine.dim,
+        "platform": "cpu",
+        "rounds_measured": len(warm_rounds),
+        "rounds_per_sec": round(n / wall, 4) if wall else None,
+        "enqueue_s_per_round": round(enq / n, 6),
+        "ready_s_per_round": round(rdy / n, 6),
+        # 6 decimals: at K=10^4 the CPU share is ~3e-6 — 4 decimals would
+        # flatten a real measurement to 0
+        "dispatch_share": round(enq / (enq + rdy), 6) if (enq + rdy) else None,
+        "compiles": int(summary["counters"].get("xla.compiles", 0)),
+        "run_id": (summary.get("run") or {}).get("run_id"),
+    }
+
+
+def cert_slice_row() -> dict:
+    """Run a certify slice as a subprocess; summarize its sweep trace."""
+    slice_out = os.path.join(OUT, "cert_slice")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "certify.py"),
+         "--quick", "--aggs", *CERT_SLICE_AGGS,
+         "--clients", "8", "--dim", "32", "--trials", "2",
+         "--out", slice_out],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=1800,
+    )
+    # the one-JSON-line contract covers in-interpreter failures; a child
+    # that died before printing (OOM-killed, import error) leaves empty
+    # stdout — surface ITS stderr, not an opaque IndexError here
+    lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+    if p.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"certify slice failed (rc={p.returncode}, "
+            f"{len(lines)} stdout lines): {p.stderr[-800:]}"
+        )
+    payload = json.loads(lines[-1])
+    from sweep_status import load_sweep_records, summarize_sweeps
+
+    trace = os.path.join(slice_out, "sweep_trace.jsonl")
+    fam = summarize_sweeps(load_sweep_records(trace))["sweeps"]["certify"]
+    return {
+        "name": "cert_slice",
+        "platform": "cpu",
+        "config": f"certify --quick aggs={','.join(CERT_SLICE_AGGS)}",
+        "cells": fam["cells"],
+        "value": fam["mean_cell_s"],  # perf_report ingestion key
+        "mean_cell_s": fam["mean_cell_s"],
+        "per_cell_overhead_s": fam["per_cell_overhead_s"],
+        "compile_s": fam["compile_s"],
+        "wall_s": fam["wall_s"],
+        "certify_ok": payload.get("ok"),
+        "run_id": payload.get("run_id"),
+    }
+
+
+README = """# Dispatch accounting baseline (measured)
+
+Generated by `python scripts/dispatch_baseline.py` (protocol in its
+docstring). `rows.jsonl` is ingested by `scripts/perf_report.py` as
+`dispatch/<name>` rows and gated by `--check` via the
+`dispatch_share_abs` / `per_cell_overhead_frac` thresholds in
+`results/perf_report/baseline.json`.
+
+- `k*_stream` rows: CPU streaming K-ladder (one virtual device,
+  trimmedmean, mlp on synthetic 28x28) — warm-round host-enqueue vs
+  device-ready split per launch (`timeline` telemetry records). The
+  `dispatch_share` column is the number ROADMAP items 2-4 must reduce.
+- `cert_slice`: a `certify.py --quick` slice; `per_cell_overhead_s` is
+  the mean per-cell program-build overhead (trace+compile) a shared
+  compiled sweep program would amortize away.
+- `cert_slice/` holds the slice's own artifacts (cert_matrix.json +
+  the per-cell `sweep_trace.jsonl`).
+
+See docs/observability.md "Dispatch accounting" and docs/performance.md.
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--ks", type=int, nargs="+", default=[100, 1000, 10000])
+    ap.add_argument("--skip-cert", action="store_true")
+    ap.add_argument("--log-root", default=os.path.join("/tmp", "dispatch_runs"))
+    args = ap.parse_args()
+
+    from blades_tpu.utils.platform import force_virtual_cpu
+
+    force_virtual_cpu(1)
+
+    os.makedirs(OUT, exist_ok=True)
+    rows = []
+    for k in args.ks:
+        print(f"[dispatch] K={k} streaming ladder...", flush=True)
+        row = ladder_row(k, args.rounds, args.log_root)
+        print(f"[dispatch] {json.dumps(row)}", flush=True)
+        rows.append(row)
+    if not args.skip_cert:
+        print("[dispatch] cert-sweep slice...", flush=True)
+        row = cert_slice_row()
+        print(f"[dispatch] {json.dumps(row)}", flush=True)
+        rows.append(row)
+
+    stamp = datetime.date.today().isoformat()
+    with open(ROWS, "w") as f:
+        for row in rows:
+            f.write(json.dumps({**row, "date": stamp}) + "\n")
+    with open(os.path.join(OUT, "README.md"), "w") as f:
+        f.write(README)
+    print(f"[dispatch] wrote {len(rows)} rows -> {ROWS}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

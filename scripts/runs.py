@@ -6,7 +6,12 @@ Reads the append-only provenance ledger (``results/ledger.jsonl``,
 ``bench.py``/``certify.py`` driver contract) summarizing the recorded
 runs: counts by kind and outcome, open (started-but-unterminated) runs,
 distinct config fingerprints, and the most recent attempts. With
-``--run-id`` the line carries that run's full attempt trail instead.
+``--run-id`` the line carries that run's full attempt trail instead —
+and, for sweep runs (certify/chaos), a ``sweep_progress`` block (cells
+completed/total, last-cell key + age, ETA) read from the per-cell
+``sweep`` records in the run's registered trace artifacts
+(``blades_tpu/telemetry/timeline.py``), so a stuck sweep is
+distinguishable from a slow one without reading the raw trace.
 With ``--tunnel`` it additionally summarizes the TPU tunnel probe log
 (``results/tpu_r5/tunnel_probes.jsonl``, written by
 ``scripts/tpu_capture.py``) into availability windows — up fraction,
@@ -88,6 +93,72 @@ def latest_rows(runs: List[Dict[str, Any]], n: int) -> List[Dict[str, Any]]:
             if metrics.get(field) is not None:
                 row[field] = metrics[field]
         out.append(row)
+    return out
+
+
+def sweep_progress(
+    trail: List[Dict[str, Any]], repo: str = REPO
+) -> Optional[Dict[str, Any]]:
+    """Sweep progress for a run's attempt trail, from the per-cell
+    ``sweep`` records in its registered trace artifacts
+    (``telemetry/timeline.py`` — certify/chaos register
+    ``sweep_trace.jsonl`` on their STARTED ledger record, so a LIVE
+    sweep is queryable too). Returns cells completed / total, the last
+    cell key, its timestamp and age — a stuck sweep (age growing, cells
+    frozen) is distinguishable from a slow one without reading the raw
+    trace. ``None`` when the trail has no sweep trace."""
+    import time
+
+    from blades_tpu.telemetry.ledger import read_ledger
+
+    paths = []
+    for r in trail:
+        for art in r.get("artifacts") or []:
+            if not isinstance(art, str) or not art.endswith(".jsonl"):
+                continue
+            p = art if os.path.isabs(art) else os.path.join(repo, art)
+            if p not in paths and os.path.exists(p):
+                paths.append(p)
+    cells: List[Dict[str, Any]] = []
+    for p in paths:
+        # read_ledger is the shared torn-line-tolerant JSONL reader — a
+        # live sweep may be mid-append
+        cells.extend(
+            r for r in read_ledger(p) if r.get("t") == "sweep"
+        )
+    # DRIVER cells only: the SweepAccounting owner stamps the i-of-N
+    # progress marker; library-level sub-cells sharing the trace (the
+    # `attack_search` family certify's cells contain) carry no `i` —
+    # counting them would report a half-done sweep as complete
+    driver = [c for c in cells if c.get("i") is not None]
+    if not driver:
+        return None
+    cells = driver
+    total = next(
+        (c["total"] for c in reversed(cells) if c.get("total") is not None),
+        None,
+    )
+    last = max(
+        cells, key=lambda c: c.get("ts") or 0,
+    )
+    out: Dict[str, Any] = {
+        # max i, not len(): duplicate artifact registrations (started +
+        # ended records both carrying the trace) must not double-count
+        "cells_completed": max(c["i"] for c in cells),
+        "total": total,
+        "last_cell": last.get("cell"),
+    }
+    if last.get("ts") is not None:
+        out["last_cell_ts"] = last["ts"]
+        out["last_cell_age_s"] = round(time.time() - last["ts"], 1)
+    if total:
+        out["frac"] = round(out["cells_completed"] / total, 4)
+    eta = next(
+        (c["eta_s"] for c in reversed(cells) if c.get("eta_s") is not None),
+        None,
+    )
+    if eta is not None:
+        out["eta_s"] = eta
     return out
 
 
@@ -197,6 +268,11 @@ def _run(argv: Optional[List[str]] = None) -> int:
             for r in trail
         ]
         payload["found"] = bool(trail)
+        # sweep runs: cells completed/total + last-cell age from the
+        # per-cell sweep records in the trail's registered trace artifacts
+        progress = sweep_progress(trail)
+        if progress is not None:
+            payload["sweep_progress"] = progress
     else:
         payload["latest"] = latest_rows(paired, args.latest)
 

@@ -1,0 +1,154 @@
+"""Live sweep progress from a sweep trace: done/total, rate, ETA, splits.
+
+Reads the ``sweep`` records a driver's
+:class:`blades_tpu.telemetry.timeline.SweepAccounting` flushes at every
+cell boundary (``scripts/certify.py``, ``scripts/chaos.py`` —
+``<out>/sweep_trace.jsonl``; plus the ``attack_search`` cells emitted
+onto the same trace) and prints ONE JSON line (the ``bench.py``
+driver contract): cells completed / total, completion fraction, last
+cell key + timestamp + age, mean cell wall, ETA, and the
+wall / compile / execute split totals — per sweep family. Because the
+driver flushes per cell, this works on a LIVE sweep: a stuck sweep shows
+a growing ``last_cell_age_s`` with ``cells`` frozen, a slow one shows
+cells advancing — distinguishable without reading the raw trace
+(the same trail ``scripts/runs.py --run-id`` reports from the ledger).
+
+Usage::
+
+    python scripts/sweep_status.py results/certification/sweep_trace.jsonl
+    python scripts/sweep_status.py <dir>     # finds <dir>/sweep_trace.jsonl
+
+Stdlib-only, no jax import — runs on any host while the sweep runs.
+Reference counterpart: none — the reference has no sweeps and no
+progress surface at all (``src/blades/simulator.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+METRIC = "sweep_status"
+
+# the one torn-line-tolerant trace reader (a live sweep may be mid-write)
+from trace_summary import load_records as load_sweep_records  # noqa: E402
+
+
+def summarize_sweeps(
+    records: List[Dict[str, Any]], now: Optional[float] = None
+) -> Dict[str, Any]:
+    """Per-sweep-family progress rollup from a record list."""
+    now = time.time() if now is None else now
+    meta = next((r for r in records if r.get("t") == "meta"), {})
+    cells = [r for r in records if r.get("t") == "sweep"]
+    families: Dict[str, Dict[str, Any]] = {}
+    for c in cells:
+        fam = families.setdefault(
+            c.get("sweep", "?"),
+            {"cells": 0, "wall_s": 0.0, "compile_s": 0.0, "execute_s": 0.0,
+             "errors": 0, "total": None, "last_cell": None, "last_ts": None,
+             "eta_s": None},
+        )
+        fam["cells"] += 1
+        fam["wall_s"] += c.get("wall_s", 0.0)
+        fam["compile_s"] += c.get("compile_s", 0.0)
+        fam["execute_s"] += c.get("execute_s", 0.0)
+        if c.get("ok") is False:
+            fam["errors"] += 1
+        if c.get("total") is not None:
+            fam["total"] = c["total"]
+        ts = c.get("ts")
+        if ts is not None and (fam["last_ts"] is None or ts >= fam["last_ts"]):
+            fam["last_ts"] = ts
+            fam["last_cell"] = c.get("cell")
+        if c.get("eta_s") is not None:
+            fam["eta_s"] = c["eta_s"]
+    out: Dict[str, Any] = {}
+    for name, fam in families.items():
+        done = fam["cells"]
+        row: Dict[str, Any] = {
+            "cells": done,
+            "wall_s": round(fam["wall_s"], 3),
+            "mean_cell_s": round(fam["wall_s"] / done, 4) if done else None,
+            # per-cell program-build overhead: the share a vmapped/shared-
+            # program sweep (ROADMAP item 2) would amortize away
+            "per_cell_overhead_s": round(
+                (fam["wall_s"] - fam["execute_s"]) / done, 4
+            ) if done else None,
+            "compile_s": round(fam["compile_s"], 3),
+            "execute_s": round(fam["execute_s"], 3),
+        }
+        if fam["total"] is not None:
+            row["total"] = fam["total"]
+            row["frac"] = round(done / fam["total"], 4) if fam["total"] else None
+        if fam["last_cell"] is not None:
+            row["last_cell"] = fam["last_cell"]
+        if fam["last_ts"] is not None:
+            row["last_ts"] = fam["last_ts"]
+            row["last_cell_age_s"] = round(now - fam["last_ts"], 1)
+        if fam["eta_s"] is not None:
+            row["eta_s"] = fam["eta_s"]
+        if fam["errors"]:
+            row["errors"] = fam["errors"]
+        out[name] = row
+    summary: Dict[str, Any] = {"sweeps": out, "cells": len(cells)}
+    if meta:
+        for key in ("run_id", "sweep", "cells_total"):
+            if key in meta:
+                summary[key] = meta[key]
+    return summary
+
+
+def resolve_trace(target: str) -> str:
+    """A trace path, or a directory containing ``sweep_trace.jsonl``."""
+    if os.path.isdir(target):
+        return os.path.join(target, "sweep_trace.jsonl")
+    return target
+
+
+def _run(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("trace",
+                   help="sweep_trace.jsonl path (or its directory)")
+    args = p.parse_args(argv)
+    path = resolve_trace(args.trace)
+    if not os.path.exists(path):
+        print(json.dumps({
+            "metric": METRIC, "ok": False,
+            "error": f"no sweep trace at {path}",
+        }))
+        return 1
+    records = load_sweep_records(path)
+    summary = summarize_sweeps(records)
+    payload = {"metric": METRIC, "trace": path, **summary, "ok": True}
+    print(json.dumps(payload))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """One-JSON-line contract, unconditionally (the ``bench.py``
+    discipline): even a bug in the status query must reach the driver as
+    a single parseable error line, never a traceback-only death."""
+    try:
+        return _run(argv)
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 - the contract IS the catch-all
+        print(json.dumps({
+            "metric": METRIC,
+            "ok": False,
+            "error": f"{type(e).__name__}: {e}"[:1000],
+        }))
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -55,6 +55,8 @@ def summarize(records: List[dict]) -> dict:
     defenses = []
     audits = []
     metrics = []
+    timelines = []
+    sweep_cells = []
     programs = []
     profile_events = []
     margins = []
@@ -91,6 +93,10 @@ def summarize(records: List[dict]) -> dict:
             audits.append(r)
         elif t == "metrics":
             metrics.append(r)
+        elif t == "timeline":
+            timelines.append(r)
+        elif t == "sweep":
+            sweep_cells.append(r)
         elif t == "async":
             asyncs.append(r)
         elif t == "memory":
@@ -240,6 +246,68 @@ def summarize(records: List[dict]) -> dict:
             r.get("stale_excluded", 0) for r in asyncs
         )
 
+    # dispatch accounting (`timeline` records, telemetry/timeline.py):
+    # per-launch host-enqueue vs device-ready split, aggregated per launch
+    # kind — THE number that says whether a slow run is dispatch-bound
+    # (the claim ROADMAP items 2-4 rest on) or device-bound
+    dispatch_summary: Dict[str, Any] = {}
+    if timelines:
+        by_kind: Dict[str, Dict[str, float]] = {}
+        for r in timelines:
+            k = by_kind.setdefault(
+                r.get("kind", "?"),
+                {"launches": 0, "rounds": 0, "enqueue_s": 0.0,
+                 "ready_s": 0.0, "compile_s": 0.0, "compiles": 0},
+            )
+            k["launches"] += r.get("launches", 0)
+            k["rounds"] += r.get("rounds", 0)
+            k["enqueue_s"] += r.get("enqueue_s", 0.0)
+            k["ready_s"] += r.get("ready_s", 0.0)
+            k["compile_s"] += r.get("compile_s", 0.0)
+            k["compiles"] += r.get("compiles", 0)
+        enq = sum(k["enqueue_s"] for k in by_kind.values())
+        rdy = sum(k["ready_s"] for k in by_kind.values())
+        for k in by_kind.values():
+            tot = k["enqueue_s"] + k["ready_s"]
+            k["dispatch_share"] = round(k["enqueue_s"] / tot, 4) if tot else 0.0
+        dispatch_summary = {
+            "launches": sum(k["launches"] for k in by_kind.values()),
+            "enqueue_s": enq,
+            "ready_s": rdy,
+            "dispatch_share": round(enq / (enq + rdy), 4)
+            if (enq + rdy)
+            else 0.0,
+            "by_kind": by_kind,
+        }
+
+    # sweep accounting (`sweep` records): per-cell progress + the
+    # wall/compile/execute split of each sweep family — scripts/
+    # sweep_status.py owns the live view; this is the post-mortem rollup
+    sweep_summary: Dict[str, Any] = {}
+    if sweep_cells:
+        fams: Dict[str, Dict[str, Any]] = {}
+        for c in sweep_cells:
+            f = fams.setdefault(
+                c.get("sweep", "?"),
+                {"cells": 0, "wall_s": 0.0, "compile_s": 0.0,
+                 "execute_s": 0.0, "total": None},
+            )
+            f["cells"] += 1
+            f["wall_s"] += c.get("wall_s", 0.0)
+            f["compile_s"] += c.get("compile_s", 0.0)
+            f["execute_s"] += c.get("execute_s", 0.0)
+            if c.get("total") is not None:
+                f["total"] = c["total"]
+        for f in fams.values():
+            n = f["cells"] or 1
+            f["mean_cell_s"] = round(f["wall_s"] / n, 4)
+            # per-cell program-build overhead: what an experiment-axis
+            # vmap / shared compiled program would amortize away
+            f["per_cell_overhead_s"] = round(
+                (f["wall_s"] - f["execute_s"]) / n, 4
+            )
+        sweep_summary = fams
+
     # measured program profiles (`memory` records): cost-model flops /
     # bytes + compiled buffer budget per program, next to the analytical
     # peak_update_bytes gauge above
@@ -313,6 +381,8 @@ def summarize(records: List[dict]) -> dict:
         "spans": spans,
         "counters": counters,
         "memory": memory_summary,
+        "dispatch": dispatch_summary,
+        "sweep": sweep_summary,
         "metrics": metrics_summary,
         "programs": program_summary,
         "heartbeat": heartbeat_summary,
@@ -417,6 +487,31 @@ def format_table(summary: dict) -> str:
         lines.append(
             f"memory: peak_update_bytes={mem['peak_update_bytes']:.0f} "
             f"({mb:.1f} MB{', ' + extras if extras else ''})"
+        )
+    disp = summary.get("dispatch") or {}
+    if disp:
+        lines.append(
+            f"dispatch accounting: share={disp['dispatch_share']:.3f} "
+            f"(host enqueue {disp['enqueue_s']:.3f}s vs device ready "
+            f"{disp['ready_s']:.3f}s over {disp['launches']} launches)"
+        )
+        for kind, k in sorted((disp.get("by_kind") or {}).items()):
+            n = k["rounds"] or 1
+            lines.append(
+                f"  {kind:<12} launches={k['launches']} rounds={k['rounds']} "
+                f"enqueue={k['enqueue_s'] / n * 1e3:.1f}ms/rnd "
+                f"ready={k['ready_s'] / n * 1e3:.1f}ms/rnd "
+                f"share={k['dispatch_share']:.3f} "
+                f"compile={k['compile_s']:.2f}s"
+            )
+    swp = summary.get("sweep") or {}
+    for name, f in sorted(swp.items()):
+        total = f" / {f['total']}" if f.get("total") is not None else ""
+        lines.append(
+            f"sweep[{name}]: {f['cells']}{total} cells, "
+            f"{f['mean_cell_s'] * 1e3:.0f}ms/cell "
+            f"(overhead {f['per_cell_overhead_s'] * 1e3:.0f}ms/cell, "
+            f"compile {f['compile_s']:.2f}s of {f['wall_s']:.2f}s wall)"
         )
     progs = summary.get("programs") or {}
     for name, p in sorted(progs.items()):
@@ -563,6 +658,39 @@ def compare_format(sa: dict, sb: dict, la: str = "A", lb: str = "B") -> str:
         fb = f"{mb:>12.0f}" if mb is not None else f"{'—':>12}"
         rr = ratio(ma, mb) if ma and mb is not None else f"{'—':>8}"
         lines.append(f"{'peak_update_bytes':<28}{fa}{fb}{rr}")
+    # dispatch accounting: per-round enqueue/ready + the share itself —
+    # the diff every dispatch-bound-claim PR must show moving
+    da, db = sa.get("dispatch") or {}, sb.get("dispatch") or {}
+    if da or db:
+        na = (sa["rounds"]["count"] or 1)
+        nb = (sb["rounds"]["count"] or 1)
+        for key, label in (("enqueue_s", "dispatch enqueue (ms/rnd)"),
+                           ("ready_s", "dispatch ready (ms/rnd)")):
+            va = da.get(key, 0.0) / na if da else None
+            vb = db.get(key, 0.0) / nb if db else None
+            fa = f"{va * 1e3:>12.1f}" if va is not None else f"{'—':>12}"
+            fb = f"{vb * 1e3:>12.1f}" if vb is not None else f"{'—':>12}"
+            rr = ratio(va, vb) if va is not None and vb is not None else f"{'—':>8}"
+            lines.append(f"{label:<28}{fa}{fb}{rr}")
+        va = da.get("dispatch_share") if da else None
+        vb = db.get("dispatch_share") if db else None
+        fa = f"{va:>12.3f}" if va is not None else f"{'—':>12}"
+        fb = f"{vb:>12.3f}" if vb is not None else f"{'—':>12}"
+        rr = ratio(va, vb) if va is not None and vb is not None else f"{'—':>8}"
+        lines.append(f"{'dispatch_share':<28}{fa}{fb}{rr}")
+    # sweep accounting: per-cell wall + build overhead per family
+    wa, wb = sa.get("sweep") or {}, sb.get("sweep") or {}
+    for fam in sorted(set(wa) | set(wb)):
+        for key, label in (
+            ("mean_cell_s", f"sweep[{fam}] cell (ms)"),
+            ("per_cell_overhead_s", f"sweep[{fam}] overhead (ms)"),
+        ):
+            va = (wa.get(fam) or {}).get(key)
+            vb = (wb.get(fam) or {}).get(key)
+            fa = f"{va * 1e3:>12.1f}" if va is not None else f"{'—':>12}"
+            fb = f"{vb * 1e3:>12.1f}" if vb is not None else f"{'—':>12}"
+            rr = ratio(va, vb) if va is not None and vb is not None else f"{'—':>8}"
+            lines.append(f"{label:<28}{fa}{fb}{rr}")
     return "\n".join(lines)
 
 

@@ -313,6 +313,58 @@ def test_schema001_sees_new_record_emitters_on_head():
     assert {"alert", "ledger"} <= emitted, sorted(emitted)
 
 
+def test_imp001_covers_timeline_module(tmp_path):
+    """PR 11 surface: the dispatch/sweep accounting module
+    (`telemetry/timeline.py`) entered the pre-jax contract set — a
+    module-scope jax import there must fire IMP001 (fire direction;
+    HEAD silence is test_tier_a_silent_on_head, runtime side is
+    test_import_timeline_before_jax)."""
+    tel = tmp_path / "blades_tpu" / "telemetry"
+    tel.mkdir(parents=True)
+    (tel / "timeline.py").write_text(
+        '"""Doc. Reference counterpart: none — test module."""\n'
+        "import jax\n"
+    )
+    violations, _ = run_rules(RepoIndex(str(tmp_path)), all_rules())
+    assert [v.rule for v in violations] == ["IMP001"], [
+        str(v) for v in violations
+    ]
+    assert violations[0].path == "blades_tpu/telemetry/timeline.py"
+
+
+def test_json001_covers_sweep_status_script(tmp_path):
+    """PR 11 surface: `scripts/sweep_status.py` (the live sweep query
+    CLI) entered the one-JSON-line contract set — a main() without the
+    catch-all funnel must fire JSON001."""
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "sweep_status.py").write_text(textwrap.dedent(
+        '''\
+        """Doc. Reference counterpart: none — test module."""
+        import json
+
+
+        def main():
+            print(json.dumps({"ok": True}))  # no try/except catch-all
+        '''
+    ))
+    violations, _ = run_rules(RepoIndex(str(tmp_path)), all_rules())
+    assert [v.rule for v in violations] == ["JSON001"], [
+        str(v) for v in violations
+    ]
+
+
+def test_schema001_sees_timeline_and_sweep_emitters_on_head():
+    """PR 11 surface: the static emit scan sees the dispatch-accounting
+    emitters — `timeline` (timeline.emit via rec.event) and `sweep`
+    (SweepAccounting cells + attack_search's sweep_cell_event) — so the
+    v3 schema types cannot silently lose their emitters (or vice versa)."""
+    from blades_tpu.analysis.rules.schema_drift import emitted_types
+
+    emitted = {t for t, _, _ in emitted_types(RepoIndex(REPO))}
+    assert {"timeline", "sweep"} <= emitted, sorted(emitted)
+
+
 def test_alias001_catches_with_statement_load(tmp_path):
     """Regression (review finding): `with np.load(path) as z:` is the
     documented numpy idiom for NpzFile and must taint the bound archive
@@ -518,6 +570,14 @@ def test_import_run_identity_modules_before_jax():
         "import blades_tpu.telemetry.context, blades_tpu.telemetry.ledger, "
         "blades_tpu.telemetry.alerts"
     )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_import_timeline_before_jax():
+    """PR 11 contract: the dispatch/sweep accounting layer must be
+    importable (and its sweep-status consumer runnable) before jax —
+    sweep progress is queried from hosts where the tunnel is down."""
+    proc = _import_probe("import blades_tpu.telemetry.timeline")
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
