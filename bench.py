@@ -41,6 +41,9 @@ PEAK_TFLOPS_V5E = 197.0
 # children: backend probe + one measurement attempt (own process each)
 # --------------------------------------------------------------------------
 
+class _SkipProfile(Exception):
+    """Internal: skip the best-effort program-profile block."""
+
 def probe_main() -> None:
     """Cheap backend liveness check: import jax, init backend, jit x+1."""
     try:
@@ -178,6 +181,22 @@ def child_main() -> None:
     buffer_m = int(os.environ.get("BENCH_BUFFER_M", max(1, k // 2)))
     staleness = os.environ.get("BENCH_STALENESS", "polynomial")
     async_max_delay = int(os.environ.get("BENCH_ASYNC_MAX_DELAY", 2))
+    # experiment-axis batching (blades_tpu/core/experiments.py):
+    # BENCH_EXPERIMENTS=S runs S independent simulations (distinct seeds,
+    # shared batches) through ONE compiled program per launch — the
+    # measured amortization number behind the batched sweep serving.
+    # Never the headline (S experiment-rounds are not one sync round's
+    # cadence); the parent labels these rows _exp<S>.
+    experiments = max(1, int(os.environ.get("BENCH_EXPERIMENTS", 1)))
+    experiment_mode = os.environ.get("BENCH_EXPERIMENT_MODE", "map")
+    if experiments > 1 and async_on:
+        print(
+            "BENCH_CHILD_RESULT "
+            + json.dumps({"error": "config: BENCH_EXPERIMENTS>1 does not "
+                                   "compose with BENCH_ASYNC=1"}),
+            flush=True,
+        )
+        sys.exit(1)
 
     stage = "import"
     try:
@@ -296,8 +315,21 @@ def child_main() -> None:
             streaming=streaming,
             async_config=async_config,
         )
-        state = engine.init(params)
         key = jax.random.PRNGKey(7)
+        ebatch = None
+        exp_keys = None
+        if experiments > 1:
+            from blades_tpu.core import ExperimentBatch
+
+            ebatch = ExperimentBatch(
+                engine, experiments, mode=experiment_mode
+            )
+            exp_keys = jax.random.split(
+                jax.random.fold_in(key, 4242), experiments
+            )
+            state = ebatch.init_batch(params)
+        else:
+            state = engine.init(params)
 
         # materialize the sampler alone first: separates a flaky-backend
         # compile error from a round-program one in the reported stage.
@@ -315,7 +347,18 @@ def child_main() -> None:
             cx, cy = ds.sample_round(
                 jax.random.fold_in(key, r), local_steps, batch
             )
-            state, m = engine.run_round(state, cx, cy, 0.1, 1.0, key)
+            if ebatch is not None:
+                # S experiments, one launch: shared batch draw, distinct
+                # per-experiment base keys (the hyperparameter-sweep data
+                # layout — [S] leading leaves everywhere else)
+                state, m, _ = ebatch.run_round_batch(
+                    state, cx, cy,
+                    jnp.full((experiments,), 0.1, jnp.float32),
+                    jnp.ones((experiments,), jnp.float32),
+                    exp_keys, shared_data=True,
+                )
+            else:
+                state, m = engine.run_round(state, cx, cy, 0.1, 1.0, key)
             # supervised-run liveness (no-op unless BLADES_HEARTBEAT_FILE
             # is set by blades_tpu.supervision)
             _beat(round_idx=r)
@@ -325,10 +368,22 @@ def child_main() -> None:
             keys = jnp.stack(
                 [jax.random.fold_in(key, r) for r in range(r0, r0 + block)]
             )
-            state, m, _ = engine.run_block(
-                state, keys, [0.1] * block, [1.0] * block, key,
-                sampler=ds.traceable_sampler(local_steps, batch),
-            )
+            if ebatch is not None:
+                sample_keys = jnp.stack([
+                    jax.random.split(keys[i], experiments)
+                    for i in range(block)
+                ])
+                lrs = jnp.full((block, experiments), 0.1, jnp.float32)
+                state, m, _ = ebatch.run_block_batch(
+                    state, sample_keys, lrs,
+                    jnp.ones((block, experiments), jnp.float32), exp_keys,
+                    sampler=ds.traceable_sampler(local_steps, batch),
+                )
+            else:
+                state, m, _ = engine.run_block(
+                    state, keys, [0.1] * block, [1.0] * block, key,
+                    sampler=ds.traceable_sampler(local_steps, batch),
+                )
             _beat(round_idx=r0 + block - 1)
             return state, m
 
@@ -385,9 +440,12 @@ def child_main() -> None:
             stop_capture(profile_dir, telem)
         timed = timed_rounds
 
-        loss = float(m.train_loss if block == 1 else m.train_loss[-1])
-        if not np.isfinite(loss):
-            raise RuntimeError(f"non-finite loss {loss}")
+        last_loss = m.train_loss if block == 1 else m.train_loss[-1]
+        if not np.isfinite(np.asarray(last_loss)).all():
+            raise RuntimeError(f"non-finite loss {np.asarray(last_loss)}")
+        # scalar for the payload: the mean over experiments ([S] with the
+        # experiment axis, scalar otherwise — finiteness checked per row)
+        loss = float(jnp.mean(jnp.asarray(last_loss)))
 
         # async payload fields: fires per tick from the cumulative state
         # counter (exact over the timed window), mean staleness averaged
@@ -485,6 +543,12 @@ def child_main() -> None:
         tflop_per_round = None
         program_profile = None
         try:
+            if ebatch is not None:
+                # the batched program's cost model is S rounds' worth; the
+                # per-round profile comes from the single-round program,
+                # which this launch never built — skip rather than lower a
+                # second program just for the payload field
+                raise _SkipProfile()
             from blades_tpu.telemetry.profiling import cost_fields
 
             if block > 1:
@@ -521,7 +585,11 @@ def child_main() -> None:
             "BENCH_CHILD_RESULT "
             + json.dumps(
                 {
-                    "rounds_per_sec": timed / elapsed,
+                    # with an experiment axis this is EXPERIMENT-rounds
+                    # per second — S simulations advancing one round each
+                    # counts S (the amortization number the batched sweep
+                    # serving is gated on); plain rounds/sec when S == 1
+                    "rounds_per_sec": timed * experiments / elapsed,
                     "clients": k,
                     # client-axis layout, self-describing (the engine may
                     # clamp the requested chunk count and pads the final
@@ -538,6 +606,16 @@ def child_main() -> None:
                     "block_size": block,
                     "rounds_per_launch": timed / launches,
                     "launches": launches,
+                    # experiment-axis batching: S independent simulations
+                    # per launch (blades_tpu/core/experiments.py); the
+                    # product is the amortization factor per dispatch
+                    "experiments": experiments,
+                    "experiment_mode": (
+                        experiment_mode if experiments > 1 else None
+                    ),
+                    "rounds_x_experiments_per_launch": (
+                        timed * experiments / launches
+                    ),
                     # buffered-async semantics (blades_tpu/asyncfl): the
                     # effective fire threshold + measured fire cadence and
                     # staleness — absent (null) on sync runs
@@ -770,6 +848,14 @@ def _ladder_main() -> None:
     if result.get("block_size") is not None:
         payload["block_size"] = result["block_size"]
         payload["rounds_per_launch"] = result.get("rounds_per_launch")
+    # experiment-axis fields (null-stripped): S simulations per launch and
+    # the amortization product — perf_report ingests them as a labeled
+    # (non-headline) _exp<S> trajectory
+    if result.get("experiments", 1) != 1:
+        payload["experiments"] = result["experiments"]
+        for field in ("experiment_mode", "rounds_x_experiments_per_launch"):
+            if result.get(field) is not None:
+                payload[field] = result[field]
     # client-axis layout: effective chunking + the program's peak
     # update-matrix bytes, so K-scaling rows are self-describing
     for field in ("client_chunks", "chunk_size", "streaming",
@@ -803,6 +889,9 @@ def _ladder_main() -> None:
         # worth of work — async throughput rows are a separate (labeled)
         # trajectory, never the headline
         or bool(result.get("async"))
+        # S batched experiments advancing a round each is the sweep-serving
+        # cadence, not the single-simulation headline cadence
+        or result.get("experiments", 1) != 1
     )
     if (
         result["clients"] != full_k
@@ -833,6 +922,8 @@ def _ladder_main() -> None:
                 payload["config"] += f"_stream{result.get('client_chunks')}"
             if result.get("async"):
                 payload["config"] += f"_asyncM{result.get('buffer_m')}"
+            if result.get("experiments", 1) != 1:
+                payload["config"] += f"_exp{result['experiments']}"
             payload["vs_baseline"] = None
     if errors:
         payload["attempt_errors"] = "; ".join(errors)[:500]

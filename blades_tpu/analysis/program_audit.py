@@ -404,6 +404,64 @@ def run_tier_b(force_platform: bool = False) -> Dict[str, Any]:
         check_retrace_stability("streaming", streaming_twice, st_engine._round_jit)
     )
 
+    # -- experiment-axis batch: donation + dtype + retrace + axis --------------
+    # (blades_tpu/core/experiments.py — S simulations through one program;
+    # the stacked RoundState is donated like the single-round state, the
+    # inner per-experiment [K, D] values keep the clients-only sharding
+    # rule, and a same-shape batch recall must add zero compiles)
+    from blades_tpu.core import ExperimentBatch, stack_experiments
+
+    _S = 2
+    e_engine, e_params = _build_engine()
+    eb = ExperimentBatch(e_engine, _S, mode="map")
+
+    def _batch_args(engine, params, plan=None):
+        states, cxs, cys = [], None, None
+        for _ in range(_S):
+            st, cxs, cys = _round_args(engine, params, plan=plan)
+            states.append(st)
+        lrs = jnp.full((_S,), 0.1, jnp.float32)
+        keys = jax.random.split(jax.random.PRNGKey(5), _S)
+        return stack_experiments(states), cxs, cys, lrs, lrs, keys
+
+    eb_jit = eb._batched_round(True)  # the shared-data jitted program
+    eb._round_jits[True] = eb_jit  # run_round_batch reuses this build
+    eb_args = _batch_args(e_engine, e_params)
+    compiled = eb_jit.lower(*eb_args).compile()
+    checks.append(check_donation("experiment_batch", compiled))
+    checks.append(check_no_f64("experiment_batch", compiled))
+
+    def batch_twice():
+        args = _batch_args(e_engine, e_params)
+        out = eb.run_round_batch(*args[:3], args[3], args[4], args[5])
+        yield jax.block_until_ready(out[0].params)
+        args = _batch_args(e_engine, e_params)
+        out = eb.run_round_batch(*args[:3], args[3], args[4], args[5])
+        yield jax.block_until_ready(out[0].params)
+
+    checks.append(
+        check_retrace_stability("experiment_batch", batch_twice, eb_jit)
+    )
+    # axis check on the SHARDED batched body (trace-only, no compile):
+    # every inner [K, D] value keeps the clients-only constraint rule
+    # under the experiment map
+    se_engine, se_params = _build_engine(plan=plan)
+    seb = ExperimentBatch(se_engine, _S, mode="map")
+
+    def _sharded_batch(states, cxs, cys, clrs, slrs, keys):
+        def one(args):
+            st, c_lr, s_lr, kk = args
+            return se_engine._round(st, cxs, cys, c_lr, s_lr, kk)
+
+        return jax.lax.map(one, (states, clrs, slrs, keys))
+
+    sb_args = _batch_args(se_engine, se_params, plan=plan)
+    closed = jax.make_jaxpr(_sharded_batch)(*sb_args)
+    res = check_sharding_axis("experiment_batch_sharded", closed)
+    res["detail"] += f" [mesh {mesh_shape}]"
+    checks.append(res)
+    del seb
+
     # -- buffered-async round: donation + dtype + retrace + axis ---------------
     # (blades_tpu/asyncfl — the version ring, per-client lag gather,
     # buffer/fire wheres and the staleness multiply are all new jitted

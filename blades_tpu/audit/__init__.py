@@ -26,6 +26,7 @@ from blades_tpu.audit.attack_search import (
     TEMPLATE_NAMES,
     search_cell,
     search_cell_staleness,
+    search_cells,
     staleness_row_weights,
     synthetic_honest,
 )
@@ -34,10 +35,12 @@ from blades_tpu.audit.contracts import (
     DEFAULT_C,
     battery_ctx,
     battery_kwargs,
+    battery_search_inputs,
     check_permutation,
     check_resilience,
     check_translation,
     nominal_f,
+    resilience_from_cell,
     run_battery,
 )
 from blades_tpu.audit.monitor import CERTIFICATE_NAMES, AuditMonitor
@@ -52,6 +55,8 @@ __all__ = [
     "TEMPLATE_NAMES",
     "battery_ctx",
     "battery_kwargs",
+    "battery_search_inputs",
+    "resilience_from_cell",
     "check_permutation",
     "check_resilience",
     "check_translation",
@@ -59,6 +64,7 @@ __all__ = [
     "run_battery",
     "search_cell",
     "search_cell_staleness",
+    "search_cells",
     "staleness_row_weights",
     "synthetic_honest",
 ]

@@ -177,54 +177,29 @@ def honest_reference(updates, byz_mask, part_mask=None):
     return mu, rho
 
 
-def search_cell(
-    agg: Aggregator,
-    trials_updates: jnp.ndarray,
-    f: int,
-    *,
-    ctx: Optional[dict] = None,
-    grids: Optional[dict] = None,
-    part_mask: Optional[jnp.ndarray] = None,
-    use_jit: bool = False,
-    cell_label: Optional[str] = None,
-) -> Dict[str, Any]:
-    """Worst-case deviation search for one (aggregator, f) cell.
-
-    ``trials_updates``: ``[T, K, D]`` honest update draws (the search runs
-    per trial and reports the worst). ``f`` is static (the aggregator's own
-    hyperparameters are static anyway); the byzantine rows are the first
-    ``f`` ids, matching the engine convention (``core/engine.py:227``).
-    The aggregator is evaluated single-shot from a fresh ``init_state``
-    (stateful defenses certify their first-round behavior; docs note).
-
-    Sweep accounting (``telemetry/timeline.py``): each call emits one
-    ``sweep`` record — ``cell_label`` (default ``f<f>/k<K>``), wall /
-    compile / execute split — onto the ACTIVE recorder, so a driver that
-    installed a trace (``scripts/certify.py``) gets per-cell telemetry
-    with no wiring here; with the NULL recorder the emit is a no-op.
-
-    Returns ``{"templates": {name: {"worst_dev", "worst_ratio"}},
-    "worst_dev", "worst_ratio", "rho"}`` — ratio is deviation over the
-    per-trial max honest deviation ``rho`` (floored at 1e-9).
-    """
-    if trials_updates.ndim == 2:
-        trials_updates = trials_updates[None]
-    t, k, d = trials_updates.shape
-    _cell_t0 = time.perf_counter()
-    _cell_counters = _trecorder.process_counters()
-    ctx = dict(ctx or {})
-    g = dict(DEFAULT_GRIDS)
-    g.update(grids or {})
+def _trial_body(agg: Aggregator, k: int, d: int, g: dict, has_part: bool,
+                ctx_keys: Tuple[str, ...]):
+    """The per-trial search body, parameterized so that EVERY cell-varying
+    input (the trial matrix, the byzantine mask, the participation mask,
+    the aggregation context arrays) is traced DATA rather than a closed-
+    over constant. One trace of this body therefore serves every cell
+    whose program SHAPE matches (same aggregator config / K / D / grids) —
+    the batching contract of :func:`search_cells` — and running it under
+    ``lax.map`` per item is bit-identical whether the items come from one
+    cell or many (the map body is the same trace either way)."""
     n_bisect = int(g["n_bisect"])
     gamma_init = float(g["gamma_init"])
-    byz_mask = jnp.arange(k) < f
 
-    def aggregate(u):
-        state = agg.init_state(k, d)
-        out, _ = agg.aggregate_masked(u, state, mask=part_mask, **ctx)
-        return out
+    def body(u, byz_mask, part_mask, ctx_leaves):
+        ctx = dict(zip(ctx_keys, ctx_leaves))
 
-    def one_trial(u):
+        def aggregate(attacked):
+            state = agg.init_state(k, d)
+            out, _ = agg.aggregate_masked(
+                attacked, state, mask=part_mask, **ctx
+            )
+            return out
+
         mu_h, rho = honest_reference(u, byz_mask, part_mask)
 
         def deviation(attacked):
@@ -259,12 +234,14 @@ def search_cell(
         ])
         return per_template, rho
 
-    def run(trials):
-        return lax.map(one_trial, trials)
+    if has_part:
+        return body
+    return lambda u, byz_mask, ctx_leaves: body(u, byz_mask, None, ctx_leaves)
 
-    if use_jit:
-        run = jax.jit(run)
-    devs, rhos = run(trials_updates)  # [T, 5], [T]
+
+def _cell_result(devs: np.ndarray, rhos: np.ndarray) -> Dict[str, Any]:
+    """``search_cell``'s result dict from one cell's ``[T, 5]`` deviations
+    and ``[T]`` honest spreads."""
     devs = np.asarray(devs, dtype=np.float64)
     rhos = np.maximum(np.asarray(rhos, dtype=np.float64), 1e-9)
     ratios = devs / rhos[:, None]
@@ -275,18 +252,179 @@ def search_cell(
         }
         for i, name in enumerate(TEMPLATE_NAMES)
     }
-    _timeline.sweep_cell_event(
-        "attack_search",
-        cell_label or f"f{f}/k{k}",
-        time.perf_counter() - _cell_t0,
-        _cell_counters,
-    )
     return {
         "templates": templates,
         "worst_dev": float(devs.max()),
         "worst_ratio": float(ratios.max()),
         "rho": float(rhos.mean()),
     }
+
+
+def search_cells(
+    agg: Aggregator,
+    cells,
+    *,
+    grids: Optional[dict] = None,
+    use_jit: bool = False,
+    batch_label: Optional[str] = None,
+) -> list:
+    """Worst-case deviation search for MANY cells through ONE program.
+
+    ``cells``: a list of dicts, one per cell — ``{"trials": [T, K, D],
+    "f": int, "ctx": dict, "part_mask": None | [K], "label": str}`` — that
+    share one program shape: the same aggregator configuration (``agg`` is
+    evaluated once per item from a fresh ``init_state``), the same trial
+    shape, the same context structure, and uniform part-mask presence
+    (:func:`blades_tpu.sweeps.plan_groups` owns the grouping rule; this
+    function asserts it). Per-cell parameters — the byzantine mask derived
+    from ``f``, the participation mask, the context arrays, the (possibly
+    staleness-weighted) trial matrices — enter as stacked traced data, so
+    the whole group is one ``lax.map`` over ``C x T`` items inside one
+    jitted program: the trace+compile that PR 11 measured at ~81% of every
+    sequential cell is paid once per GROUP.
+
+    Bit-exactness: :func:`search_cell` routes through this function with
+    ``C = 1``, and a ``lax.map`` item's result depends only on its own
+    inputs — so batched results are bit-identical to sequential ones
+    (pinned in ``tests/test_sweeps.py``).
+
+    Sweep accounting: one ``sweep`` record per cell with the shared
+    ``batch`` key and ``batch_size``, amortized wall, and the group's
+    compile counters on the first cell (``telemetry/timeline.py
+    .sweep_batch_events``).
+
+    Returns one :func:`search_cell`-shaped result dict per cell, in input
+    order.
+    """
+    cells = list(cells)
+    if not cells:
+        return []
+    t0 = time.perf_counter()
+    counters0 = _trecorder.process_counters()
+    g = dict(DEFAULT_GRIDS)
+    g.update(grids or {})
+
+    trials = [
+        c["trials"][None] if c["trials"].ndim == 2 else c["trials"]
+        for c in cells
+    ]
+    t, k, d = trials[0].shape
+    for tr in trials[1:]:
+        if tr.shape != (t, k, d):
+            raise ValueError(
+                f"cells in one batch must share the trial shape: "
+                f"{tr.shape} != {(t, k, d)}"
+            )
+    has_part = [c.get("part_mask") is not None for c in cells]
+    if any(has_part) != all(has_part):
+        raise ValueError(
+            "cells in one batch must have uniform part-mask presence"
+        )
+    has_part = has_part[0]
+    ctx_keys = tuple(sorted((cells[0].get("ctx") or {})))
+    for c in cells[1:]:
+        if tuple(sorted((c.get("ctx") or {}))) != ctx_keys:
+            raise ValueError(
+                "cells in one batch must share the aggregation-context "
+                "structure"
+            )
+
+    n = len(cells)
+    u = jnp.reshape(jnp.stack(trials), (n * t, k, d))
+    byz = jnp.repeat(
+        jnp.stack([jnp.arange(k) < c["f"] for c in cells]), t, axis=0
+    )
+    args = [u, byz]
+    if has_part:
+        part = jnp.repeat(
+            jnp.stack([jnp.asarray(c["part_mask"]).astype(bool)
+                       for c in cells]),
+            t, axis=0,
+        )
+        args.append(part)
+    ctx_stacks = tuple(
+        jnp.repeat(
+            jnp.stack([jnp.asarray((c.get("ctx") or {})[key])
+                       for c in cells]),
+            t, axis=0,
+        )
+        for key in ctx_keys
+    )
+    args.append(ctx_stacks)
+
+    body = _trial_body(agg, k, d, g, has_part, ctx_keys)
+
+    def run(*xs):
+        return lax.map(lambda item: body(*item), tuple(xs))
+
+    if use_jit:
+        run = jax.jit(run)
+    devs, rhos = run(*args)  # [C*T, 5], [C*T]
+    devs = np.asarray(devs, np.float64).reshape(n, t, len(TEMPLATE_NAMES))
+    rhos = np.asarray(rhos, np.float64).reshape(n, t)
+    results = [_cell_result(devs[i], rhos[i]) for i in range(n)]
+
+    wall = time.perf_counter() - t0
+    labels = [
+        c.get("label") or f"f{c['f']}/k{k}" for c in cells
+    ]
+    if n == 1:
+        _timeline.sweep_cell_event("attack_search", labels[0], wall, counters0)
+    else:
+        _timeline.sweep_batch_events(
+            "attack_search", labels, wall, counters0,
+            batch=batch_label or f"batch{n}/k{k}",
+        )
+    return results
+
+
+def search_cell(
+    agg: Aggregator,
+    trials_updates: jnp.ndarray,
+    f: int,
+    *,
+    ctx: Optional[dict] = None,
+    grids: Optional[dict] = None,
+    part_mask: Optional[jnp.ndarray] = None,
+    use_jit: bool = False,
+    cell_label: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Worst-case deviation search for one (aggregator, f) cell.
+
+    ``trials_updates``: ``[T, K, D]`` honest update draws (the search runs
+    per trial and reports the worst). ``f`` is static (the aggregator's own
+    hyperparameters are static anyway); the byzantine rows are the first
+    ``f`` ids, matching the engine convention (``core/engine.py:227``).
+    The aggregator is evaluated single-shot from a fresh ``init_state``
+    (stateful defenses certify their first-round behavior; docs note).
+
+    This is the single-cell (``C = 1``) form of :func:`search_cells` — the
+    same traced body, so a sequential sweep and a batched one produce
+    bit-identical numbers per cell.
+
+    Sweep accounting (``telemetry/timeline.py``): each call emits one
+    ``sweep`` record — ``cell_label`` (default ``f<f>/k<K>``), wall /
+    compile / execute split — onto the ACTIVE recorder, so a driver that
+    installed a trace (``scripts/certify.py``) gets per-cell telemetry
+    with no wiring here; with the NULL recorder the emit is a no-op.
+
+    Returns ``{"templates": {name: {"worst_dev", "worst_ratio"}},
+    "worst_dev", "worst_ratio", "rho"}`` — ratio is deviation over the
+    per-trial max honest deviation ``rho`` (floored at 1e-9).
+    """
+    k = trials_updates.shape[-2]
+    return search_cells(
+        agg,
+        [{
+            "trials": trials_updates,
+            "f": int(f),
+            "ctx": dict(ctx or {}),
+            "part_mask": part_mask,
+            "label": cell_label or f"f{int(f)}/k{k}",
+        }],
+        grids=grids,
+        use_jit=use_jit,
+    )[0]
 
 
 # -- staleness-aware templates (buffered-async threat model) ------------------

@@ -154,6 +154,24 @@ def check_translation(agg: Aggregator, updates, ctx=None, key=None) -> Dict[str,
     return {"contract": "translation", "residual": res, "ok": bool(ok)}
 
 
+def resilience_from_cell(cell: Dict[str, Any], f: int,
+                         c: float = DEFAULT_C) -> Dict[str, Any]:
+    """The resilience-contract result dict from a completed
+    :func:`~blades_tpu.audit.attack_search.search_cell` result — the
+    shared formatting between the sequential battery and a batched sweep
+    that served the battery's search cell from a warm program group."""
+    return {
+        "contract": "resilience",
+        "f": int(f),
+        "c": float(c),
+        "worst_ratio": cell["worst_ratio"],
+        "worst_dev": cell["worst_dev"],
+        "rho": cell["rho"],
+        "templates": cell["templates"],
+        "ok": bool(cell["worst_ratio"] <= c),
+    }
+
+
 def check_resilience(
     agg: Aggregator,
     trials_updates,
@@ -169,16 +187,32 @@ def check_resilience(
     spread."""
     cell = search_cell(agg, trials_updates, f, ctx=ctx, grids=grids,
                        use_jit=use_jit)
-    return {
-        "contract": "resilience",
-        "f": int(f),
-        "c": float(c),
-        "worst_ratio": cell["worst_ratio"],
-        "worst_dev": cell["worst_dev"],
-        "rho": cell["rho"],
-        "templates": cell["templates"],
-        "ok": bool(cell["worst_ratio"] <= c),
-    }
+    return resilience_from_cell(cell, f, c)
+
+
+def battery_search_inputs(
+    agg: Aggregator,
+    k: int,
+    d: int,
+    *,
+    trials: int = 1,
+    seed: int = 0,
+    name: Optional[str] = None,
+    f: Optional[int] = None,
+):
+    """``(trials_updates, f, ctx)`` for the battery's resilience search —
+    the single owner of its key-split rule, shared by :func:`run_battery`
+    and the batched certify driver (which groups this search cell with
+    the breakdown cells of the same aggregator configuration and passes
+    the completed result back via ``run_battery(resilience=...)``)."""
+    name = name or type(agg).__name__.lower()
+    if f is None:
+        f = max(1, nominal_f(name, k))
+    key = jax.random.PRNGKey(seed)
+    k_data, _k_perm, _k_trans, k_ctx = jax.random.split(key, 4)
+    trials_updates = synthetic_honest(k_data, trials, k, d)
+    ctx = battery_ctx(agg, k, d, key=k_ctx)
+    return trials_updates, f, ctx
 
 
 def run_battery(
@@ -193,12 +227,19 @@ def run_battery(
     seed: int = 0,
     grids: Optional[dict] = None,
     use_jit: bool = False,
+    resilience: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Dict[str, Any]]:
     """Run all three contracts against one aggregator instance; returns
     ``{contract: result}`` with each result carrying ``ok`` plus the
     measured residual/ratio. ``f`` defaults to ``max(1, nominal_f)`` so the
     resilience check is never vacuous — aggregators with breakdown point 0
     (mean) fail it and must declare the documented opt-out.
+
+    ``resilience``: a precomputed resilience-contract result (from
+    :func:`resilience_from_cell`) — the batched certify driver computes
+    the battery's search cell inside a warm program group
+    (``battery_search_inputs`` pins the identical inputs) and passes it
+    here instead of paying a per-battery compile.
     """
     name = name or type(agg).__name__.lower()
     if f is None:
@@ -211,9 +252,11 @@ def run_battery(
     return {
         "permutation": check_permutation(agg, u0, ctx, key=k_perm),
         "translation": check_translation(agg, u0, ctx, key=k_trans),
-        "resilience": check_resilience(
-            agg, trials_updates, f, ctx=ctx, c=c,
-            grids=grids if grids is not None else QUICK_GRIDS,
-            use_jit=use_jit,
+        "resilience": resilience if resilience is not None else (
+            check_resilience(
+                agg, trials_updates, f, ctx=ctx, c=c,
+                grids=grids if grids is not None else QUICK_GRIDS,
+                use_jit=use_jit,
+            )
         ),
     }

@@ -14,5 +14,18 @@ from blades_tpu.core.engine import (
     ClientOptSpec,
     ServerOptSpec,
 )
+from blades_tpu.core.experiments import (
+    ExperimentBatch,
+    stack_experiments,
+    unstack_experiments,
+)
 
-__all__ = ["RoundEngine", "RoundState", "ClientOptSpec", "ServerOptSpec"]
+__all__ = [
+    "RoundEngine",
+    "RoundState",
+    "ClientOptSpec",
+    "ServerOptSpec",
+    "ExperimentBatch",
+    "stack_experiments",
+    "unstack_experiments",
+]

@@ -899,6 +899,7 @@ class RoundEngine:
         part0 = valid
         corrupt = jnp.zeros(k, bool)
         corrupt_key = round_key  # placeholder; unused without a fault model
+        corrupt_fill = None
         n_dropped = jnp.asarray(0, jnp.int32)
         if self.fault_model is not None:
             fault_key = jax.random.fold_in(round_key, rng.FAULT)
@@ -906,6 +907,10 @@ class RoundEngine:
                 k, fault_key, state.round_idx
             )
             n_dropped = jnp.sum(drop.astype(jnp.int32))
+            if self.fault_model.value_corruption:
+                # traced fill scalar (faults/model.py): nan/inf twin
+                # configs share this compiled program
+                corrupt_fill = state.fault_state["fill"]
 
         sctx = dict(
             params_flat=ravel(state.params),
@@ -963,7 +968,8 @@ class RoundEngine:
             mom = moments_update(mom, upd, val)
             if self.fault_model is not None:
                 upd = self.fault_model.corrupt_chunk(
-                    upd, cor, jax.random.fold_in(corrupt_key, j)
+                    upd, cor, jax.random.fold_in(corrupt_key, j),
+                    fill=corrupt_fill,
                 )
                 part_c = p0
                 if self.fault_model.guard_nonfinite:

@@ -1,0 +1,315 @@
+"""Experiment-axis batching: S independent simulations, ONE compiled program.
+
+PR 11 measured exactly where sweep wall-clock goes: every certification /
+chaos / hyperparameter cell is ~81% trace+compile overhead
+(``results/dispatch/cert_slice``), because each cell dispatches its own
+tiny program. The round body is already a fixed-shape jit pytree function
+(``core/engine.py``), so S independent experiments — different seeds,
+learning rates, initial states, fault fills — can share one compiled
+program and amortize that overhead S-fold. This module is that batch axis.
+
+Two schedules, both ONE program per batch:
+
+- ``mode="map"`` (default): ``lax.map`` over the experiment axis — the S
+  experiments execute sequentially INSIDE the program. The map body is the
+  exact ``RoundEngine._round`` trace applied per experiment, so a batched
+  run is **bit-identical** to S sequential ``run_round``/``run_block``
+  calls (pinned across the full 16-aggregator registry in
+  ``tests/test_experiments.py``). This is the sweep-serving schedule: the
+  win is amortized trace/lower/compile + one dispatch, which is what the
+  dispatch accounting says dominates.
+- ``mode="vmap"``: ``jax.vmap`` over the experiment axis — the S
+  experiments execute as one batched computation (training matmuls gain a
+  leading batch dimension). Numerically equivalent but NOT bit-identical
+  to sequential runs: XLA batches the local-training reductions
+  differently (measured on this backend: every aggregator's params drift
+  in the last ulp). Use it when a single experiment underfills the chip
+  and cross-experiment parallelism pays; use ``map`` when results must be
+  comparable bit-for-bit with sequential artifacts.
+
+Per-experiment leaves are stacked leading-``[S]`` (``RoundState`` stacks
+via the existing pytree carry — :func:`stack_experiments`); seeds / lrs /
+per-experiment batches become ``[S]``-leading arrays; diagnostics come
+back stacked and are unstacked on host exactly like ``run_block`` does
+for rounds (:func:`unstack_experiments`). Aggregator / attack / fault
+HYPERPARAMETERS that live as traced state leaves (e.g. the fault model's
+corrupt fill value) batch for free; static Python hyperparameters define
+the program shape — experiments in one batch must share them (that is
+what :func:`blades_tpu.sweeps.program_fingerprint` groups by).
+
+Reference counterpart: none — the reference runs one simulation per
+process and re-enters Python every round (``src/blades/simulator.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from blades_tpu.telemetry import get_recorder
+from blades_tpu.telemetry import timeline as _timeline
+
+_MODES = ("map", "vmap")
+
+
+def stack_experiments(trees: List[Any]) -> Any:
+    """Stack S structurally-identical pytrees into one leading-``[S]``
+    pytree (the batched ``RoundState`` / metrics layout)."""
+    if not trees:
+        raise ValueError("stack_experiments needs at least one pytree")
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def unstack_experiments(tree: Any, num_experiments: Optional[int] = None) -> List[Any]:
+    """Invert :func:`stack_experiments`: a leading-``[S]`` pytree back to a
+    list of S per-experiment pytrees (host-side convenience — the arrays
+    stay device-resident views)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if num_experiments is None:
+        if not leaves:
+            raise ValueError("cannot infer S from an empty pytree")
+        num_experiments = int(leaves[0].shape[0])
+    return [
+        jax.tree_util.tree_map(lambda a: a[s], tree)
+        for s in range(num_experiments)
+    ]
+
+
+class ExperimentBatch:
+    """S independent simulations of one :class:`RoundEngine` config as one
+    compiled program per launch.
+
+    All S experiments share the engine's STATIC configuration (model, K,
+    f, attack/aggregator/fault classes and their Python hyperparameters —
+    the program shape); they differ in traced data: initial state, rng
+    keys, learning-rate schedules, per-experiment batches, and any
+    hyperparameter that enters as a state leaf. ``init_batch`` broadcasts
+    one template state S ways; arbitrary per-experiment states stack via
+    :func:`stack_experiments`.
+
+    One jit program is built per (schedule mode, data layout) and cached —
+    re-running any number of same-shape batches adds zero compiles
+    (pinned in ``tests/test_experiments.py``).
+    """
+
+    def __init__(self, engine, num_experiments: int, mode: str = "map"):
+        if mode not in _MODES:
+            raise ValueError(f"mode must be one of {_MODES}, got {mode!r}")
+        if int(num_experiments) < 1:
+            raise ValueError(
+                f"num_experiments must be >= 1, got {num_experiments}"
+            )
+        self.engine = engine
+        self.num_experiments = int(num_experiments)
+        self.mode = mode
+        # one cached jit per (kind, shared_data) layout; block programs
+        # additionally key on the sampler identity like run_block does
+        self._round_jits: Dict[bool, Callable] = {}
+        self._block_jit: Optional[Callable] = None
+        self._block_sampler: Optional[Callable] = None
+        self._timeline_attrs = {
+            **engine._timeline_attrs,
+            "experiments": self.num_experiments,
+        }
+
+    # -- state ----------------------------------------------------------------
+
+    def init_batch(self, params: Any, seeds: Optional[List[int]] = None) -> Any:
+        """A leading-``[S]`` ``RoundState`` stack: S fresh engine states
+        from one params template (every experiment starts from the same
+        model; per-experiment divergence comes from keys/lrs/data)."""
+        del seeds  # reserved: per-experiment init randomization
+        return stack_experiments(
+            [self.engine.init(params) for _ in range(self.num_experiments)]
+        )
+
+    # -- the batched round program ---------------------------------------------
+
+    def _batched_round(self, shared_data: bool) -> Callable:
+        eng = self.engine
+
+        def run(states, cx, cy, client_lrs, server_lrs, keys):
+            if self.mode == "vmap":
+                d_ax = None if shared_data else 0
+                return jax.vmap(
+                    eng._round, in_axes=(0, d_ax, d_ax, 0, 0, 0)
+                )(states, cx, cy, client_lrs, server_lrs, keys)
+
+            if shared_data:
+                def one(args):
+                    st, c_lr, s_lr, kk = args
+                    # cx/cy are jit ARGUMENTS closed over as tracers (never
+                    # Python constants): constant-folding the batches would
+                    # perturb matmul layouts and break the bit-exactness
+                    # contract vs sequential run_round
+                    return eng._round(st, cx, cy, c_lr, s_lr, kk)
+
+                xs = (states, client_lrs, server_lrs, keys)
+            else:
+                def one(args):
+                    st, cx_s, cy_s, c_lr, s_lr, kk = args
+                    return eng._round(st, cx_s, cy_s, c_lr, s_lr, kk)
+
+                xs = (states, cx, cy, client_lrs, server_lrs, keys)
+            return lax.map(one, xs)
+
+        return jax.jit(run, donate_argnums=(0,))
+
+    def run_round_batch(
+        self,
+        states: Any,
+        cx: jnp.ndarray,
+        cy: jnp.ndarray,
+        client_lrs,
+        server_lrs,
+        keys: jax.Array,
+        shared_data: Optional[bool] = None,
+    ) -> Tuple[Any, Any, Dict[str, Any]]:
+        """One federated round of all S experiments as ONE XLA program.
+
+        ``states``: leading-``[S]`` ``RoundState`` stack. ``cx``/``cy``:
+        either one shared ``[K, S, B, ...]`` batch (every experiment
+        trains on the same draw — the hyperparameter-sweep layout) or
+        per-experiment ``[S, K, ...]`` stacks. ``client_lrs`` /
+        ``server_lrs`` / ``keys``: ``[S]`` per-experiment leaves.
+
+        Returns ``(new_states, metrics, diags)`` with every leaf stacked
+        leading-``[S]`` — :func:`unstack_experiments` recovers the
+        per-experiment views, exactly like ``run_block`` unstacks rounds.
+        Bit-exactness contract (``mode="map"``): experiment ``s`` of the
+        batch equals an isolated ``run_round`` with that experiment's
+        inputs, bit-for-bit, across the full aggregator registry
+        (``tests/test_experiments.py``).
+        """
+        eng = self.engine
+        s = self.num_experiments
+        if shared_data is None:
+            lead = jax.tree_util.tree_leaves(cx)[0].shape[0]
+            # [S, K, ...] stacks lead with S; the shared layout leads with K.
+            # Ambiguous only when S == K — then the caller must say.
+            if s == eng.num_clients:
+                raise ValueError(
+                    "shared_data is ambiguous when num_experiments == "
+                    "num_clients; pass shared_data explicitly"
+                )
+            shared_data = lead != s
+        jit = self._round_jits.get(shared_data)
+        if jit is None:
+            jit = self._round_jits[shared_data] = self._batched_round(
+                shared_data
+            )
+        client_lrs = jnp.asarray(client_lrs, jnp.float32)
+        server_lrs = jnp.asarray(server_lrs, jnp.float32)
+        _timeline.launch_begin(
+            "experiment_batch", rounds=s, attrs=self._timeline_attrs
+        )
+        with get_recorder().span("dispatch", rounds=s):
+            out = jit(states, cx, cy, client_lrs, server_lrs, keys)
+        _timeline.launch_enqueued()
+        return self._unpack(out)
+
+    # -- the batched round-block program ---------------------------------------
+
+    def _build_block(self, sampler: Callable) -> Callable:
+        eng = self.engine
+
+        def block(states, sample_keys, client_lrs, server_lrs, keys):
+            def body(sts, per_round):
+                skeys, c_lrs, s_lrs = per_round  # each [S]
+
+                def one(args):
+                    st, sk, c_lr, s_lr, kk = args
+                    cx, cy = sampler(sk)
+                    return eng._round(st, cx, cy, c_lr, s_lr, kk)
+
+                outs = lax.map(one, (sts, skeys, c_lrs, s_lrs, keys))
+                # metrics + diagnostics only: like run_block, the per-round
+                # [S, K, D] update matrix stays internal to each scan step
+                # (a program output would persist R x S matrices in HBM)
+                return outs[0], (outs[1],) + outs[3:]
+
+            final, ys = lax.scan(
+                body, states, (sample_keys, client_lrs, server_lrs)
+            )
+            return final, ys
+
+        return jax.jit(block, donate_argnums=(0,))
+
+    def run_block_batch(
+        self,
+        states: Any,
+        sample_keys: jnp.ndarray,
+        client_lrs,
+        server_lrs,
+        keys: jax.Array,
+        sampler: Callable = None,
+    ) -> Tuple[Any, Any, Dict[str, Any]]:
+        """``R x S`` federated rounds as ONE XLA program: the scan-of-
+        batched-rounds composition — ``lax.scan`` over R rounds outside,
+        the experiment map inside, the dataset sampler fused in exactly
+        like ``run_block``.
+
+        ``sample_keys``: ``[R, S]`` per-round-per-experiment sampling
+        keys; ``client_lrs``/``server_lrs``: ``[R, S]`` schedules;
+        ``keys``: ``[S]`` base keys. Returns ``(new_states, metrics,
+        diags)`` with metric/diag leaves stacked ``[R, S, ...]``.
+        Bit-exactness contract (``mode="map"``): column ``s`` equals that
+        experiment's own ``run_block`` (which itself equals R sequential
+        rounds), so batch scheduling composes with block scheduling as a
+        pure scheduling choice (``tests/test_experiments.py``)."""
+        if sampler is None:
+            raise ValueError(
+                "run_block_batch needs the dataset's traceable sampler"
+            )
+        if self.mode != "map":
+            raise ValueError(
+                "run_block_batch supports mode='map' only (the vmap "
+                "schedule cannot keep the per-experiment sampler draws "
+                "bit-identical to run_block's)"
+            )
+        if self._block_jit is None or self._block_sampler is not sampler:
+            self._block_jit = self._build_block(sampler)
+            self._block_sampler = sampler
+        r = int(sample_keys.shape[0])
+        s = self.num_experiments
+        client_lrs = jnp.asarray(client_lrs, jnp.float32)
+        server_lrs = jnp.asarray(server_lrs, jnp.float32)
+        _timeline.launch_begin(
+            "experiment_batch", rounds=r * s, attrs=self._timeline_attrs
+        )
+        with get_recorder().span("dispatch", rounds=r * s):
+            final, ys = self._block_jit(
+                states, sample_keys, client_lrs, server_lrs, keys
+            )
+        _timeline.launch_enqueued()
+        metrics = ys[0]
+        diags = self._diag_dict(ys[1:])
+        return final, metrics, diags
+
+    # -- output plumbing -------------------------------------------------------
+
+    def _unpack(self, out):
+        (
+            new_states, metrics, updates, agg_diag, fault_diag, audit_diag,
+            metric_pack, async_diag,
+        ) = out
+        eng = self.engine
+        eng.last_updates = updates if eng.keep_updates else None
+        diags = self._diag_dict(
+            (agg_diag, fault_diag, audit_diag, metric_pack, async_diag)
+        )
+        return new_states, metrics, diags
+
+    def _diag_dict(self, ys) -> Dict[str, Any]:
+        agg_diag, fault_diag, audit_diag, mpacks, adiags = ys
+        eng = self.engine
+        return {
+            "defense": agg_diag if eng.collect_diagnostics else None,
+            "faults": fault_diag if eng.fault_model is not None else None,
+            "audit": audit_diag if eng.audit_monitor is not None else None,
+            "metrics": mpacks if eng.round_metrics else None,
+            "async": adiags if eng.async_config is not None else None,
+        }

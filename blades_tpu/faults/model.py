@@ -95,16 +95,53 @@ class FaultModel:
     def has_stragglers(self) -> bool:
         return self.straggler_rate > 0.0
 
+    @property
+    def value_corruption(self) -> bool:
+        """True when whole-row value corruption (NaN/Inf fill) is actually
+        configured. The fill value is then TRACED STATE, not a compiled
+        constant — so the ``nan`` and ``inf`` configurations share one
+        compiled round program (they differ only in a state leaf), which
+        is what lets a warm-program cache (``blades_tpu/sweeps``) serve a
+        chaos scenario and its NaN<->Inf inertness twin from one build."""
+        return self.corrupt_mode in ("nan", "inf") and bool(
+            self.corrupt_rate > 0.0 or self.corrupt_clients
+        )
+
+    @property
+    def _fill_value(self) -> float:
+        return float("nan") if self.corrupt_mode == "nan" else float("inf")
+
     def init_state(self, num_clients: int, dim: int) -> Any:
-        """Stale-update replay buffer (empty pytree when stragglers are off,
-        so fault-free configs pay nothing in state/checkpoint size)."""
-        if not self.has_stragglers:
-            return ()
-        return {
-            "stale": jnp.zeros((num_clients, dim), jnp.float32),
-            "age": jnp.zeros((num_clients,), jnp.int32),
-            "has": jnp.zeros((num_clients,), bool),
-        }
+        """Stale-update replay buffer + (when value corruption is
+        configured) the traced corrupt fill scalar; the empty pytree when
+        neither is on, so fault-free configs pay nothing in
+        state/checkpoint size."""
+        state = {}
+        if self.has_stragglers:
+            state.update({
+                "stale": jnp.zeros((num_clients, dim), jnp.float32),
+                "age": jnp.zeros((num_clients,), jnp.int32),
+                "has": jnp.zeros((num_clients,), bool),
+            })
+        if self.value_corruption:
+            state["fill"] = jnp.asarray(self._fill_value, jnp.float32)
+        return state if state else ()
+
+    def static_fingerprint(self) -> Any:
+        """The PROGRAM-shape view of this config (``blades_tpu.sweeps
+        .static_fingerprint`` calls this): every field that changes the
+        traced program, with the NaN/Inf fill collapsed to ``"value"``
+        when it is traced state — two configs mapping equal here compile
+        to the same program and may share a warm engine."""
+        fields = dataclasses.asdict(self)
+        if self.value_corruption:
+            fields["corrupt_mode"] = "value"
+        sched = fields.get("participation_schedule")
+        if sched is not None:
+            fields["participation_schedule"] = [
+                [bool(v) for v in row] for row in np.asarray(sched)
+            ]
+        return fields
 
     # -- the in-graph fault pass ----------------------------------------------
 
@@ -141,6 +178,11 @@ class FaultModel:
             )
             part = fresh | stale_ok
             new_state = {
+                **(
+                    {"fill": state["fill"]}
+                    if self.value_corruption and "fill" in state
+                    else {}
+                ),
                 "stale": jnp.where(
                     fresh[:, None], updates.astype(jnp.float32), state["stale"]
                 ),
@@ -165,10 +207,22 @@ class FaultModel:
                 jnp.arange(k, dtype=jnp.int32)[:, None] == ids[None, :], axis=1
             )
         corrupt &= part  # only delivered payloads can arrive corrupted
-        if self.corrupt_mode == "nan":
-            out = jnp.where(corrupt[:, None], jnp.nan, out)
-        elif self.corrupt_mode == "inf":
-            out = jnp.where(corrupt[:, None], jnp.inf, out)
+        if self.value_corruption:
+            # the fill rides the state as a TRACED scalar (init_state), so
+            # the nan and inf configurations are one compiled program — a
+            # warm-program cache serves the chaos inertness twin for free.
+            # Direct callers that hand-roll a state without the fill leaf
+            # (ad-hoc apply() use, pre-existing tests) get the constant.
+            fill = (
+                state["fill"]
+                if isinstance(state, dict) and "fill" in state
+                else jnp.asarray(self._fill_value, jnp.float32)
+            )
+            out = jnp.where(corrupt[:, None], fill.astype(out.dtype), out)
+        elif self.corrupt_mode in ("nan", "inf"):
+            # no corruption configured: the mask is statically all-False,
+            # keep the constant (and the pre-existing compiled program)
+            out = jnp.where(corrupt[:, None], self._fill_value, out)
         else:  # bitflip: sign-flip + power-of-two scale on a coord subset
             flip = jax.random.bernoulli(kb, self.bitflip_frac, out.shape)
             flipped = jnp.where(flip, -self.bitflip_scale * out, out)
@@ -236,14 +290,20 @@ class FaultModel:
         return part, drop, corrupt, kb
 
     def corrupt_chunk(
-        self, slab: jnp.ndarray, corrupt: jnp.ndarray, key: jax.Array
+        self, slab: jnp.ndarray, corrupt: jnp.ndarray, key: jax.Array,
+        fill: Any = None,
     ) -> jnp.ndarray:
         """Row-local payload corruption for one ``[chunk, D]`` slab
-        (``corrupt`` is the chunk's slice of the planned victim mask)."""
-        if self.corrupt_mode == "nan":
-            return jnp.where(corrupt[:, None], jnp.nan, slab)
-        if self.corrupt_mode == "inf":
-            return jnp.where(corrupt[:, None], jnp.inf, slab)
+        (``corrupt`` is the chunk's slice of the planned victim mask).
+        ``fill``: the traced fill scalar from the fault state when value
+        corruption is configured (the streaming engine passes
+        ``fault_state['fill']``); ``None`` keeps the static constant."""
+        if self.corrupt_mode in ("nan", "inf"):
+            value = (
+                fill.astype(slab.dtype) if fill is not None
+                else self._fill_value
+            )
+            return jnp.where(corrupt[:, None], value, slab)
         flip = jax.random.bernoulli(key, self.bitflip_frac, slab.shape)
         flipped = jnp.where(flip, -self.bitflip_scale * slab, slab)
         return jnp.where(corrupt[:, None], flipped, slab)

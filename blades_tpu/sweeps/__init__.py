@@ -1,0 +1,348 @@
+"""Warm-program sweep serving: group cells by program shape, compile once
+per group, execute batched.
+
+PR 11's dispatch accounting measured every certification / chaos sweep
+cell at ~81% trace+compile (``results/dispatch/cert_slice``): thousands
+of tiny programs, each paying its own build. This package is the serving
+layer that amortizes it, in two forms:
+
+- **Cell grouping** (:func:`plan_groups` / :func:`run_grouped`): attack-
+  search cells (``scripts/certify.py``) whose PROGRAM SHAPE matches —
+  same aggregator configuration (every static hyperparameter, by value),
+  same trial tensor shape, same aggregation-context structure, uniform
+  part-mask presence — are dispatched through one jitted
+  :func:`~blades_tpu.audit.attack_search.search_cells` program, with the
+  per-cell parameters (byzantine masks, staleness-weighted trials,
+  context arrays) as stacked traced data. Cells that differ in any
+  static input (different K, different ``num_byzantine`` clamps,
+  different aggregator state pytrees) land in DIFFERENT groups by
+  construction — the fingerprint covers every constructor attribute —
+  and are never silently batched (``tests/test_sweeps.py``).
+
+- **Engine caching** (:class:`EngineCache`): sweep drivers that build one
+  :class:`~blades_tpu.core.RoundEngine` per scenario (``scripts/
+  chaos.py``) key the built engine by its :func:`program_fingerprint`;
+  a scenario whose static configuration matches a previous one (the
+  chaos NaN<->Inf inertness twins, whose corrupt fill is a traced state
+  leaf — ``blades_tpu/faults``) reuses the warm compiled programs
+  instead of paying a fresh trace+compile.
+
+The fingerprint is the ledger's config fingerprint
+(``telemetry/ledger.py``) over a canonical normalization of arbitrary
+config objects (:func:`static_fingerprint`): dataclasses and plain
+objects decompose into their attribute dicts, arrays hash by
+shape/dtype/bytes, and objects exposing ``static_fingerprint()`` (the
+fault model) substitute their own program-relevant view — which is how
+two configs that compile to the same program map to the same key.
+
+Reference counterpart: none — the reference runs one configuration per
+process and has no sweep machinery at all (``src/blades/simulator.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from blades_tpu.telemetry.ledger import config_fingerprint
+
+__all__ = [
+    "EngineCache",
+    "SweepCell",
+    "contains_callables",
+    "group_key",
+    "plan_groups",
+    "program_fingerprint",
+    "run_grouped",
+    "static_fingerprint",
+]
+
+
+# -- canonical config normalization -------------------------------------------
+
+
+def _hash_bytes(raw: bytes) -> str:
+    return hashlib.sha256(raw).hexdigest()[:12]
+
+
+def static_fingerprint(obj: Any, _depth: int = 0) -> Any:
+    """A canonical, JSON-stable view of a config object's STATIC content.
+
+    Arrays collapse to ``(shape, dtype, content-hash)`` — equal-valued
+    arrays fingerprint equal, different values differ (a trace-time
+    constant with a different value is a different program). Objects that
+    know their own program-relevant view (``static_fingerprint()``
+    method, e.g. :class:`~blades_tpu.faults.FaultModel` collapsing its
+    traced corrupt fill) supply it; dataclasses and plain objects
+    decompose into attribute dicts; callables fingerprint by qualified
+    name (two differently-bound closures of the same function are NOT
+    distinguished — callers exclude per-run callables from keys).
+    """
+    if _depth > 8:
+        return repr(obj)
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    method = getattr(obj, "static_fingerprint", None)
+    if callable(method) and not isinstance(obj, type):
+        return {"__static__": type(obj).__name__,
+                "view": method()}
+    if isinstance(obj, dict):
+        return {
+            str(k): static_fingerprint(v, _depth + 1)
+            for k, v in sorted(obj.items(), key=lambda kv: str(kv[0]))
+        }
+    if isinstance(obj, (list, tuple)):
+        return [static_fingerprint(v, _depth + 1) for v in obj]
+    # numpy / jax arrays (duck-typed: anything with shape+dtype+tobytes)
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+        import numpy as np
+
+        arr = np.asarray(obj)
+        return {
+            "__array__": [list(arr.shape), str(arr.dtype),
+                          _hash_bytes(arr.tobytes())],
+        }
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            "__class__": type(obj).__name__,
+            **{
+                f.name: static_fingerprint(getattr(obj, f.name), _depth + 1)
+                for f in dataclasses.fields(obj)
+            },
+        }
+    # plain functions / methods / classes only — an INSTANCE defining
+    # __call__ (every Aggregator) must fall through to the attribute-dict
+    # branch, or all of its configurations would collapse to one key
+    import types
+
+    if isinstance(obj, (types.FunctionType, types.MethodType,
+                        types.BuiltinFunctionType, type)):
+        return {"__callable__": getattr(obj, "__qualname__", repr(obj))}
+    attrs = getattr(obj, "__dict__", None)
+    if attrs is not None:
+        return {
+            "__class__": type(obj).__name__,
+            **{
+                k: static_fingerprint(v, _depth + 1)
+                for k, v in sorted(attrs.items())
+                # per-call caches / last-run outputs are not config
+                if not k.startswith("_")
+            },
+        }
+    return repr(obj)
+
+
+def contains_callables(view: Any) -> bool:
+    """True when a :func:`static_fingerprint` view contains a bare
+    callable marker anywhere. Closures collapse to their qualified name
+    in the view — two differently-bound lambdas would fingerprint equal —
+    so cache users (``Simulator.run(engine_cache=...)``) must BYPASS
+    caching for any config carrying one, rather than risk serving the
+    wrong program."""
+    if isinstance(view, dict):
+        return "__callable__" in view or any(
+            contains_callables(v) for v in view.values()
+        )
+    if isinstance(view, list):
+        return any(contains_callables(v) for v in view)
+    return False
+
+
+def program_fingerprint(**parts: Any) -> str:
+    """Short stable hash of a program-shape config: the warm-program cache
+    key and the sweep batch label. Built on the ledger's
+    ``config_fingerprint`` so sweep batches, engine-cache keys, and ledger
+    provenance all speak the same fingerprint dialect."""
+    return config_fingerprint(static_fingerprint(parts))
+
+
+# -- attack-search cell grouping ----------------------------------------------
+
+
+@dataclasses.dataclass
+class SweepCell:
+    """One attack-search sweep cell awaiting (possibly batched) execution.
+
+    ``agg`` defines the program shape together with the trial shape and
+    context structure; ``f`` / ``part_mask`` / ``ctx`` / ``trials`` are
+    the traced per-cell data; ``payload`` rides through untouched for the
+    driver's result assembly (scenario labels, staleness descriptors).
+    """
+
+    label: str
+    agg: Any
+    trials: Any
+    f: int
+    ctx: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    part_mask: Any = None
+    payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def group_key(cell: SweepCell) -> str:
+    """The program-shape fingerprint of one cell: cells agree iff one
+    compiled search program can serve them all (same aggregator config by
+    value, same ``[T, K, D]`` trial shape, same context structure, same
+    part-mask presence)."""
+    trials = cell.trials
+    shape = tuple(trials.shape[-3:]) if trials.ndim == 3 else (
+        (1,) + tuple(trials.shape)
+    )
+    return program_fingerprint(
+        agg=cell.agg,
+        trial_shape=list(shape),
+        trial_dtype=str(trials.dtype),
+        ctx_keys=sorted(cell.ctx or {}),
+        has_part=cell.part_mask is not None,
+    )
+
+
+def plan_groups(
+    cells: Sequence[SweepCell],
+) -> List[Tuple[str, List[int]]]:
+    """Group cell indices by program shape, preserving first-seen group
+    order and input order within each group."""
+    order: List[str] = []
+    groups: Dict[str, List[int]] = {}
+    for i, cell in enumerate(cells):
+        key = group_key(cell)
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(i)
+    return [(key, groups[key]) for key in order]
+
+
+def run_grouped(
+    cells: Sequence[SweepCell],
+    *,
+    grids: Optional[dict] = None,
+    use_jit: bool = True,
+    sweep=None,
+    return_walls: bool = False,
+):
+    """Execute attack-search cells grouped by program shape; results come
+    back in INPUT order, each the :func:`~blades_tpu.audit.attack_search
+    .search_cell` result dict for that cell (bit-identical to running the
+    cells sequentially — the batched map body is the same trace).
+
+    ``sweep``: an optional :class:`~blades_tpu.telemetry.timeline
+    .SweepAccounting` — each cell is marked complete via
+    ``sweep.record`` with its amortized wall and the shared ``batch`` key
+    (the driver's i-of-N / ETA trail keeps working; grouped cells land
+    together at the group boundary). The library-level ``attack_search``
+    records carry the same batch stamps either way.
+    """
+    from blades_tpu.audit.attack_search import search_cells
+    from blades_tpu.telemetry import recorder as _trecorder
+    from blades_tpu.telemetry.timeline import _counter_delta
+
+    cells = list(cells)
+    results: List[Optional[Dict[str, Any]]] = [None] * len(cells)
+    walls: List[float] = [0.0] * len(cells)
+    for key, idxs in plan_groups(cells):
+        group = [cells[i] for i in idxs]
+        t0 = time.perf_counter()
+        counters0 = _trecorder.process_counters()
+        try:
+            outs = search_cells(
+                group[0].agg,
+                [
+                    {
+                        "trials": c.trials,
+                        "f": c.f,
+                        "ctx": c.ctx,
+                        "part_mask": c.part_mask,
+                        "label": c.label,
+                    }
+                    for c in group
+                ],
+                grids=grids,
+                use_jit=use_jit,
+                batch_label=key,
+            )
+        except Exception as e:
+            # a batched failure must still leave an attributable trail:
+            # one ok:false record per cell of the group (the sequential
+            # path's cell() context records errors on exit — a crashed
+            # batched sweep must not read as merely stuck)
+            if sweep is not None:
+                wall = time.perf_counter() - t0
+                delta = _counter_delta(counters0)
+                for j, c in enumerate(group):
+                    sweep.record(
+                        c.label,
+                        wall / len(group),
+                        counter_delta=delta if j == 0 else None,
+                        batch=key,
+                        batch_size=len(group),
+                        error=f"{type(e).__name__}: {e}",
+                    )
+            raise
+        wall = time.perf_counter() - t0
+        delta = _counter_delta(counters0)
+        # amortize the EXECUTE share alongside the wall (the build delta
+        # lands on the first cell, sums-not-means): summed over the group,
+        # wall == W and execute == W - compile - trace, so the per-family
+        # overhead rollup measures the amortized build cost, exactly like
+        # the library-level sweep_batch_events records
+        exec_share = max(
+            0.0,
+            wall - delta.get("compile_s", 0.0) - delta.get("trace_s", 0.0),
+        ) / len(group)
+        for i, out in zip(idxs, outs):
+            results[i] = out
+            walls[i] = wall / len(group)
+        if sweep is not None:
+            for j, c in enumerate(group):
+                sweep.record(
+                    c.label,
+                    wall / len(group),
+                    counter_delta=delta if j == 0 else None,
+                    execute_s=round(exec_share, 6),
+                    batch=key,
+                    batch_size=len(group),
+                )
+    if return_walls:
+        return results, walls
+    return results  # type: ignore[return-value]
+
+
+# -- warm engine cache ---------------------------------------------------------
+
+
+class EngineCache:
+    """Process-level warm-program cache for sweep drivers: maps a
+    :func:`program_fingerprint` to a built value (a
+    :class:`~blades_tpu.core.RoundEngine` plus whatever the driver pairs
+    with it). A hit means the compiled round/eval programs are already
+    warm — the chaos twin/rerun scenarios' whole trace+compile cost
+    becomes one dict lookup. Hit/miss counters feed the sweep summary so
+    the amortization is a reported number, not an assumption."""
+
+    def __init__(self):
+        self._entries: Dict[str, Any] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: str) -> Any:
+        value = self._entries.get(key)
+        if value is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return value
+
+    def put(self, key: str, value: Any) -> None:
+        self._entries[key] = value
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "entries": len(self._entries),
+            "hits": self.hits,
+            "misses": self.misses,
+        }

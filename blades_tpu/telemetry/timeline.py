@@ -280,6 +280,66 @@ class SweepAccounting:
         static labels copied onto the record, schema-permitting)."""
         return _Cell(self, str(key), fields)
 
+    def record(
+        self,
+        key: str,
+        wall_s: float,
+        counter_delta: Optional[Dict[str, Any]] = None,
+        **fields,
+    ) -> None:
+        """Mark one cell complete WITHOUT the context manager — the
+        batched-sweep form: a group of cells completes in one program
+        execution, and the driver back-fills each cell's (amortized) wall
+        and its share of the group's counter delta. Emits the same driver
+        ``sweep`` record (i-of-N, ETA), flushes, and beats the heartbeat
+        exactly like :class:`_Cell` exit; grouped cells stamp
+        ``batch``/``batch_size`` via ``fields``; an ``error=`` field marks
+        the cell failed (``ok: false``), like a raising ``cell()``
+        context."""
+        error = fields.pop("error", None)
+        self._emit(
+            str(key), float(wall_s), dict(counter_delta or {}), fields,
+            error=error,
+        )
+
+    def _emit(
+        self, key: str, wall: float, delta: Dict[str, Any], fields: dict,
+        error: Optional[str] = None,
+    ) -> None:
+        self.done += 1
+        rate = (time.perf_counter() - self._t0) / max(self.done, 1)
+        rec_fields: Dict[str, Any] = {
+            "sweep": self.kind,
+            "cell": key,
+            "ts": time.time(),
+            "i": self.done,
+            "total": self.total,
+            "wall_s": round(wall, 6),
+            "eta_s": round(max(0.0, rate * (self.total - self.done)), 1),
+            # execute_s approximates the non-build share of the cell: wall
+            # minus trace+compile. Host dispatch overhead is inside it —
+            # the launch accounting (timeline records) owns that split.
+            "execute_s": round(
+                max(0.0, wall - delta.get("compile_s", 0.0)
+                    - delta.get("trace_s", 0.0)), 6,
+            ),
+            **delta,
+            **fields,
+        }
+        if error is not None:
+            rec_fields["ok"] = False
+            rec_fields["error"] = error[:300]
+        self.rec.event("sweep", **rec_fields)
+        # cell boundary: one buffered trace write + one heartbeat touch —
+        # a supervised sweep's liveness signal between Simulator flushes
+        self.rec.flush()
+        try:
+            from blades_tpu.supervision import heartbeat as _heartbeat
+
+            _heartbeat.beat(round_idx=self.done)
+        except Exception:  # noqa: BLE001 - accounting must never kill a sweep
+            pass
+
     def summary(self) -> Dict[str, Any]:
         return {
             "sweep": self.kind,
@@ -306,42 +366,15 @@ class _Cell:
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        sw = self._sw
-        wall = time.perf_counter() - self._t0
-        delta = _counter_delta(self._counters0)
-        sw.done += 1
-        rate = (time.perf_counter() - sw._t0) / max(sw.done, 1)
-        rec_fields: Dict[str, Any] = {
-            "sweep": sw.kind,
-            "cell": self._key,
-            "ts": time.time(),
-            "i": sw.done,
-            "total": sw.total,
-            "wall_s": round(wall, 6),
-            "eta_s": round(max(0.0, rate * (sw.total - sw.done)), 1),
-            # execute_s approximates the non-build share of the cell: wall
-            # minus trace+compile. Host dispatch overhead is inside it —
-            # the launch accounting (timeline records) owns that split.
-            "execute_s": round(
-                max(0.0, wall - delta.get("compile_s", 0.0)
-                    - delta.get("trace_s", 0.0)), 6,
+        self._sw._emit(
+            self._key,
+            time.perf_counter() - self._t0,
+            _counter_delta(self._counters0),
+            self._fields,
+            error=(
+                f"{exc_type.__name__}: {exc}" if exc_type is not None else None
             ),
-            **delta,
-            **self._fields,
-        }
-        if exc_type is not None:
-            rec_fields["ok"] = False
-            rec_fields["error"] = f"{exc_type.__name__}: {exc}"[:300]
-        sw.rec.event("sweep", **rec_fields)
-        # cell boundary: one buffered trace write + one heartbeat touch —
-        # a supervised sweep's liveness signal between Simulator flushes
-        sw.rec.flush()
-        try:
-            from blades_tpu.supervision import heartbeat as _heartbeat
-
-            _heartbeat.beat(round_idx=sw.done)
-        except Exception:  # noqa: BLE001 - accounting must never kill a sweep
-            pass
+        )
         return False
 
 
@@ -376,3 +409,48 @@ def sweep_cell_event(
         **delta,
         **fields,
     )
+
+
+def sweep_batch_events(
+    sweep: str,
+    cells,
+    wall_s: float,
+    counters_before: Dict[str, float],
+    batch: str,
+    rec=None,
+    **fields,
+) -> None:
+    """Emit one ``sweep`` record per cell of a BATCHED group — cells that
+    shared one compiled program execution (``audit.attack_search
+    .search_cells``). Each record carries the shared ``batch`` key and
+    ``batch_size``, an amortized per-cell ``wall_s`` (``wall_s / C`` — the
+    group's wall tiles across its cells so per-family totals stay exact),
+    and the group's compile/trace counter delta stamped on the FIRST cell
+    only (sums, not means — ``sweep_status`` adds them up). With the NULL
+    recorder active this is a no-op, like :func:`sweep_cell_event`."""
+    rec = rec if rec is not None else _recorder.get_recorder()
+    if not rec.enabled:
+        return
+    cells = list(cells)
+    if not cells:
+        return
+    delta = _counter_delta(counters_before)
+    share = wall_s / len(cells)
+    exec_total = max(
+        0.0,
+        wall_s - delta.get("compile_s", 0.0) - delta.get("trace_s", 0.0),
+    )
+    now = time.time()
+    for i, cell in enumerate(cells):
+        rec.event(
+            "sweep",
+            sweep=sweep,
+            cell=str(cell),
+            ts=now,
+            wall_s=round(share, 6),
+            execute_s=round(exec_total / len(cells), 6),
+            batch=batch,
+            batch_size=len(cells),
+            **(delta if i == 0 else {}),
+            **fields,
+        )

@@ -92,38 +92,89 @@ def total_cells(args) -> int:
     return len(names) * (1 + f_cells * per_f)
 
 
+def _cell_row(name, f, f_nom, cell, c, search_s) -> dict:
+    return {
+        "agg": name,
+        "f": f,
+        "nominal_f": f_nom,
+        "worst_dev": round(cell["worst_dev"], 6),
+        "worst_ratio": round(cell["worst_ratio"], 4),
+        "rho": round(cell["rho"], 6),
+        "certified": bool(cell["worst_ratio"] <= c),
+        "within_nominal": f <= f_nom,
+        "templates": {
+            t: round(v["worst_ratio"], 4)
+            for t, v in cell["templates"].items()
+        },
+        "search_s": round(search_s, 2),
+    }
+
+
+def _battery_entry(agg, f_nom, res) -> dict:
+    # read opt-outs from the INSTANCE: configuration-dependent defenses
+    # shadow the class dict with the variant's own set (clustering's
+    # metric='distance' drops the similarity-specific resilience
+    # opt-out, aggregators/clustering.py), so a variant regression
+    # cannot hide behind the default configuration's opt-out
+    optouts = dict(getattr(agg, "audit_optouts", {}) or {})
+    return {
+        "nominal_f": f_nom,
+        "contracts": {
+            cname: {
+                "ok": r["ok"],
+                "measured": r.get("residual", r.get("worst_ratio")),
+                "optout": optouts.get(cname),
+            }
+            for cname, r in res.items()
+        },
+    }
+
+
 def certify_matrix(args, sweep=None) -> dict:
+    """The full certification matrix. Default: the WARM-PROGRAM batched
+    sweep — every attack-search cell (battery resilience, breakdown,
+    staleness columns) becomes a :class:`blades_tpu.sweeps.SweepCell`,
+    cells sharing a program shape are grouped by config fingerprint and
+    dispatched through ONE jitted ``search_cells`` program per group
+    (``blades_tpu/sweeps``), amortizing the ~81%-of-cell-wall
+    trace+compile PR 11 measured. Results are bit-identical to the
+    sequential path (``--sequential``; the map body is the same trace —
+    pinned by ``tests/test_sweeps.py``); only the ``search_s`` timing
+    fields differ (amortized group wall per cell vs per-cell wall)."""
     import jax
 
-    from blades_tpu.aggregators import get_aggregator
     from blades_tpu.audit import (
         DEFAULT_C,
         DEFAULT_GRIDS,
         QUICK_GRIDS,
         battery_ctx,
-        battery_kwargs,
+        battery_search_inputs,
         nominal_f,
+        resilience_from_cell,
         run_battery,
         search_cell,
         search_cell_staleness,
+        staleness_row_weights,
         synthetic_honest,
     )
+    from blades_tpu.sweeps import SweepCell, run_grouped
 
     k, d, trials = args.clients, args.dim, args.trials
     grids = QUICK_GRIDS if args.quick else DEFAULT_GRIDS
     c = args.c if args.c is not None else DEFAULT_C
     f_max = (k - 1) // 2
     names = tuple(args.aggs) if args.aggs else CERT_POOL
+    sequential = bool(getattr(args, "sequential", False))
 
     key = jax.random.PRNGKey(args.seed)
     trials_updates = synthetic_honest(key, trials, k, d)
     ctx = battery_ctx(None, k, d, key=jax.random.fold_in(key, 1))
 
-    # sweep accounting (telemetry/timeline.py): every cell below runs
-    # inside `sweep.cell(...)` — one per-cell `sweep` record (wall/compile/
-    # execute split, i-of-N, ETA) flushed at the cell boundary, plus a
+    # sweep accounting (telemetry/timeline.py): every cell below lands as
+    # one per-cell `sweep` record (wall/compile/execute split, i-of-N,
+    # ETA) flushed at the cell (or batched-group) boundary, plus a
     # heartbeat touch so a supervised sweep stays visibly alive. A None
-    # sweep (library callers, tests) degrades to a no-op context.
+    # sweep (library callers, tests) degrades to a no-op.
     if sweep is None:
         from contextlib import nullcontext
 
@@ -131,94 +182,120 @@ def certify_matrix(args, sweep=None) -> dict:
             def cell(self, key_, **kw):
                 return nullcontext()
 
+            def record(self, key_, wall_s, counter_delta=None, **kw):
+                pass
+
         sweep = _NullSweep()
 
-    battery, cells, async_cells = {}, [], []
+    scenarios = () if args.no_async else (
+        ("fresh_byz", 0), ("stale_byz", args.tau_max),
+    )
+
+    # -- enumerate every attack-search cell as a SweepCell --------------------
+    # (battery resilience + breakdown + staleness columns; the batched
+    # path groups them by program fingerprint, the sequential path walks
+    # the same list one compiled program per cell)
+    specs, plans = [], []
     for name in names:
         base, _, _ = name.partition(":")
         f_nom = nominal_f(base, k)
-        # -- contract battery at f = max(1, nominal) --------------------------
-        agg = build_aggregator(name, k, max(1, f_nom))
-        with sweep.cell(f"battery/{name}"):
-            res = run_battery(
-                agg, k=k, d=d, f=max(1, f_nom), name=base, c=c, trials=trials,
-                seed=args.seed, grids=grids, use_jit=not args.no_jit,
-            )
-        # read opt-outs from the INSTANCE: configuration-dependent defenses
-        # shadow the class dict with the variant's own set (clustering's
-        # metric='distance' drops the similarity-specific resilience
-        # opt-out, aggregators/clustering.py), so a variant regression
-        # cannot hide behind the default configuration's opt-out
-        optouts = dict(getattr(agg, "audit_optouts", {}) or {})
-        battery[name] = {
-            "nominal_f": f_nom,
-            "contracts": {
-                cname: {
-                    "ok": r["ok"],
-                    "measured": r.get("residual", r.get("worst_ratio")),
-                    "optout": optouts.get(cname),
-                }
-                for cname, r in res.items()
-            },
-        }
-        # -- breakdown matrix over f ------------------------------------------
+        bat_agg = build_aggregator(name, k, max(1, f_nom))
+        bat_trials, bat_f, bat_ctx = battery_search_inputs(
+            bat_agg, k, d, trials=trials, seed=args.seed, name=base,
+        )
+        plans.append(("battery", name, bat_agg, f_nom, None, None))
+        specs.append(SweepCell(
+            label=f"battery/{name}", agg=bat_agg, trials=bat_trials,
+            f=bat_f, ctx=bat_ctx,
+        ))
         for f in range(f_max + 1):
             agg_f = build_aggregator(name, k, f)
-            t0 = time.time()
-            with sweep.cell(f"{name}/f{f}"):
-                cell = search_cell(
-                    agg_f, trials_updates, f, ctx=ctx, grids=grids,
-                    use_jit=not args.no_jit,
-                    cell_label=f"{name}/f{f}",
+            plans.append(("cell", name, agg_f, f_nom, f, None))
+            specs.append(SweepCell(
+                label=f"{name}/f{f}", agg=agg_f, trials=trials_updates,
+                f=f, ctx=ctx,
+            ))
+            for scenario, tau_byz in scenarios:
+                # the staleness-weighted matrix is per-cell DATA: honest
+                # rows pre-scaled by their normalized weights, exactly as
+                # search_cell_staleness prepares them — so async columns
+                # batch with the sync cells of the same aggregator config
+                mask, w, _tau = staleness_row_weights(
+                    k, f, mode="polynomial", alpha=0.5,
+                    tau_max=args.tau_max, tau_byz=tau_byz,
                 )
-            cells.append({
-                "agg": name,
-                "f": f,
-                "nominal_f": f_nom,
-                "worst_dev": round(cell["worst_dev"], 6),
-                "worst_ratio": round(cell["worst_ratio"], 4),
-                "rho": round(cell["rho"], 6),
-                "certified": bool(cell["worst_ratio"] <= c),
-                "within_nominal": f <= f_nom,
-                "templates": {
-                    t: round(v["worst_ratio"], 4)
-                    for t, v in cell["templates"].items()
-                },
-                "search_s": round(time.time() - t0, 2),
-            })
-            # -- staleness-aware async columns (same cell, two byzantine
-            #    reporting-time choices; skipped with --no-async) ------------
-            if args.no_async:
-                continue
-            for scenario, tau_byz in (
-                ("fresh_byz", 0), ("stale_byz", args.tau_max),
-            ):
-                t0 = time.time()
-                with sweep.cell(f"{name}/f{f}/{scenario}"):
-                    acell = search_cell_staleness(
-                        agg_f, trials_updates, f,
+                weighted = trials_updates * w[None, :, None]
+                part = None if bool(jax.numpy.all(mask)) else mask
+                staleness_info = {
+                    "mode": "polynomial",
+                    "alpha": 0.5,
+                    "tau_max": int(args.tau_max),
+                    "tau_byz": int(tau_byz),
+                    "weight_byz": float(w[0]) if f > 0 else None,
+                    "weight_min": float(jax.numpy.min(
+                        jax.numpy.where(mask, w, jax.numpy.inf)
+                    )),
+                }
+                plans.append(
+                    ("async", name, agg_f, f_nom, f, (scenario,
+                                                      staleness_info))
+                )
+                specs.append(SweepCell(
+                    label=f"{name}/f{f}/{scenario}", agg=agg_f,
+                    trials=weighted, f=f, ctx=ctx, part_mask=part,
+                ))
+
+    # -- execute --------------------------------------------------------------
+    if sequential:
+        results, walls = [], []
+        for plan, spec in zip(plans, specs):
+            t0 = time.time()
+            with sweep.cell(spec.label):
+                if plan[0] == "async":
+                    scenario, _info = plan[5]
+                    cell = search_cell_staleness(
+                        plan[2], trials_updates, plan[4],
                         mode="polynomial", alpha=0.5,
-                        tau_max=args.tau_max, tau_byz=tau_byz,
+                        tau_max=args.tau_max,
+                        tau_byz=0 if scenario == "fresh_byz" else args.tau_max,
                         ctx=ctx, grids=grids, use_jit=not args.no_jit,
-                        cell_label=f"{name}/f{f}/{scenario}",
+                        cell_label=spec.label,
                     )
-                async_cells.append({
-                    "agg": name,
-                    "f": f,
-                    "nominal_f": f_nom,
-                    "scenario": scenario,
-                    "worst_dev": round(acell["worst_dev"], 6),
-                    "worst_ratio": round(acell["worst_ratio"], 4),
-                    "rho": round(acell["rho"], 6),
-                    "certified": bool(acell["worst_ratio"] <= c),
-                    "within_nominal": f <= f_nom,
-                    "staleness": acell["staleness"],
-                    "templates": {
-                        t: round(v["worst_ratio"], 4)
-                        for t, v in acell["templates"].items()
-                    },
-                    "search_s": round(time.time() - t0, 2),
-                })
+                else:
+                    cell = search_cell(
+                        spec.agg, spec.trials, spec.f, ctx=spec.ctx,
+                        grids=grids, use_jit=not args.no_jit,
+                        cell_label=spec.label,
+                    )
+            results.append(cell)
+            walls.append(time.time() - t0)
+    else:
+        results, walls = run_grouped(
+            specs, grids=grids, use_jit=not args.no_jit, sweep=sweep,
+            return_walls=True,
+        )
+
+    # -- assemble (identical row order and content either way) ----------------
+    battery, cells, async_cells = {}, [], []
+    for plan, spec, cell, wall in zip(plans, specs, results, walls):
+        kind, name, agg, f_nom, f, extra = plan
+        base, _, _ = name.partition(":")
+        if kind == "battery":
+            res = run_battery(
+                agg, k=k, d=d, f=max(1, f_nom), name=base, c=c,
+                trials=trials, seed=args.seed, grids=grids,
+                use_jit=not args.no_jit,
+                resilience=resilience_from_cell(cell, spec.f, c),
+            )
+            battery[name] = _battery_entry(agg, f_nom, res)
+        elif kind == "cell":
+            cells.append(_cell_row(name, f, f_nom, cell, c, wall))
+        else:
+            scenario, staleness_info = extra
+            row = _cell_row(name, f, f_nom, cell, c, wall)
+            row["scenario"] = scenario
+            row["staleness"] = staleness_info
+            async_cells.append(row)
 
     # -- headline expectations ------------------------------------------------
     by = {(r["agg"], r["f"]): r for r in cells}
@@ -280,6 +357,7 @@ def certify_matrix(args, sweep=None) -> dict:
         "f_max": f_max,
         "c": c,
         "grids": "quick" if args.quick else "default",
+        "batched": not sequential,
         "seed": args.seed,
         "templates_per_cell": 5,
         "tau_max": args.tau_max,
@@ -314,6 +392,12 @@ def main() -> int:
                         "columns (rounds)")
     p.add_argument("--no-jit", action="store_true",
                    help="eager per-cell evaluation (tiny matrices only)")
+    p.add_argument("--sequential", action="store_true",
+                   help="one compiled program per cell (the pre-batching "
+                        "path; the default groups cells by program "
+                        "fingerprint and compiles once per group — "
+                        "bit-identical results, ~N_cells/N_groups fewer "
+                        "compiles)")
     p.add_argument("--out", default=os.path.join(REPO, "results",
                                                  "certification"))
     args = p.parse_args()
@@ -352,6 +436,7 @@ def main() -> int:
             "trials": args.trials,
             "seed": args.seed,
             "quick": bool(args.quick),
+            "batched": not args.sequential,
             "aggs": sorted(args.aggs) if args.aggs else None,
         },
         artifacts=[os.path.relpath(sweep_trace, REPO)],

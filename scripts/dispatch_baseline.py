@@ -110,9 +110,16 @@ def ladder_row(k: int, rounds: int, log_root: str) -> dict:
     }
 
 
-def cert_slice_row() -> dict:
-    """Run a certify slice as a subprocess; summarize its sweep trace."""
-    slice_out = os.path.join(OUT, "cert_slice")
+def cert_slice_row(batched: bool = False) -> dict:
+    """Run a certify slice as a subprocess; summarize its sweep trace.
+
+    ``batched=False`` forces ``--sequential`` — the committed per-cell
+    baseline the amortization is measured against. ``batched=True`` runs
+    the default warm-program grouped path (``blades_tpu/sweeps``); the
+    pair's ``mean_cell_s`` ratio is perf_report's ``sweep_batch_speedup``
+    derived claim, gated by ``--check``."""
+    suffix = "_batched" if batched else ""
+    slice_out = os.path.join(OUT, f"cert_slice{suffix}")
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -120,6 +127,7 @@ def cert_slice_row() -> dict:
         [sys.executable, os.path.join(REPO, "scripts", "certify.py"),
          "--quick", "--aggs", *CERT_SLICE_AGGS,
          "--clients", "8", "--dim", "32", "--trials", "2",
+         *([] if batched else ["--sequential"]),
          "--out", slice_out],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=1800,
     )
@@ -137,10 +145,13 @@ def cert_slice_row() -> dict:
 
     trace = os.path.join(slice_out, "sweep_trace.jsonl")
     fam = summarize_sweeps(load_sweep_records(trace))["sweeps"]["certify"]
-    return {
-        "name": "cert_slice",
+    row = {
+        "name": f"cert_slice{suffix}",
         "platform": "cpu",
-        "config": f"certify --quick aggs={','.join(CERT_SLICE_AGGS)}",
+        "config": (
+            f"certify --quick aggs={','.join(CERT_SLICE_AGGS)}"
+            + ("" if batched else " --sequential")
+        ),
         "cells": fam["cells"],
         "value": fam["mean_cell_s"],  # perf_report ingestion key
         "mean_cell_s": fam["mean_cell_s"],
@@ -150,6 +161,10 @@ def cert_slice_row() -> dict:
         "certify_ok": payload.get("ok"),
         "run_id": payload.get("run_id"),
     }
+    if batched and fam.get("batches") is not None:
+        row["batches"] = fam["batches"]
+        row["cells_per_program"] = fam.get("cells_per_program")
+    return row
 
 
 README = """# Dispatch accounting baseline (measured)
@@ -164,11 +179,20 @@ docstring). `rows.jsonl` is ingested by `scripts/perf_report.py` as
   trimmedmean, mlp on synthetic 28x28) — warm-round host-enqueue vs
   device-ready split per launch (`timeline` telemetry records). The
   `dispatch_share` column is the number ROADMAP items 2-4 must reduce.
-- `cert_slice`: a `certify.py --quick` slice; `per_cell_overhead_s` is
-  the mean per-cell program-build overhead (trace+compile) a shared
-  compiled sweep program would amortize away.
-- `cert_slice/` holds the slice's own artifacts (cert_matrix.json +
+- `cert_slice`: a `certify.py --quick --sequential` slice — one compiled
+  program per cell; `per_cell_overhead_s` is the mean per-cell
+  program-build overhead (trace+compile). This is the committed
+  SEQUENTIAL baseline.
+- `cert_slice_batched`: the same slice through the warm-program grouped
+  path (`blades_tpu/sweeps`: cells grouped by program fingerprint, one
+  compiled `search_cells` program per group). The
+  `cert_slice / cert_slice_batched` `mean_cell_s` ratio is perf_report's
+  `sweep_batch_speedup` derived claim, gated >= 3x by `--check`.
+- `cert_slice*/` hold the slices' own artifacts (cert_matrix.json +
   the per-cell `sweep_trace.jsonl`).
+
+Regenerate just the cert slices (the K-ladder rows are expensive and
+stay committed) with `python scripts/dispatch_baseline.py --only-cert`.
 
 See docs/observability.md "Dispatch accounting" and docs/performance.md.
 """
@@ -179,6 +203,10 @@ def main() -> int:
     ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument("--ks", type=int, nargs="+", default=[100, 1000, 10000])
     ap.add_argument("--skip-cert", action="store_true")
+    ap.add_argument("--only-cert", action="store_true",
+                    help="re-measure only the cert-slice rows, merging "
+                         "them into the existing rows.jsonl (the K-ladder "
+                         "rows are expensive and stay committed)")
     ap.add_argument("--log-root", default=os.path.join("/tmp", "dispatch_runs"))
     args = ap.parse_args()
 
@@ -188,21 +216,44 @@ def main() -> int:
 
     os.makedirs(OUT, exist_ok=True)
     rows = []
-    for k in args.ks:
-        print(f"[dispatch] K={k} streaming ladder...", flush=True)
-        row = ladder_row(k, args.rounds, args.log_root)
-        print(f"[dispatch] {json.dumps(row)}", flush=True)
-        rows.append(row)
+    if not args.only_cert:
+        for k in args.ks:
+            print(f"[dispatch] K={k} streaming ladder...", flush=True)
+            row = ladder_row(k, args.rounds, args.log_root)
+            print(f"[dispatch] {json.dumps(row)}", flush=True)
+            rows.append(row)
     if not args.skip_cert:
-        print("[dispatch] cert-sweep slice...", flush=True)
-        row = cert_slice_row()
-        print(f"[dispatch] {json.dumps(row)}", flush=True)
-        rows.append(row)
+        # the sequential slice is the committed per-cell BASELINE; the
+        # batched slice is the warm-program measurement — their
+        # mean_cell_s ratio is perf_report's sweep_batch_speedup gate
+        for batched in (False, True):
+            label = "batched" if batched else "sequential"
+            print(f"[dispatch] cert-sweep slice ({label})...", flush=True)
+            row = cert_slice_row(batched=batched)
+            print(f"[dispatch] {json.dumps(row)}", flush=True)
+            rows.append(row)
 
     stamp = datetime.date.today().isoformat()
-    with open(ROWS, "w") as f:
-        for row in rows:
-            f.write(json.dumps({**row, "date": stamp}) + "\n")
+    if args.only_cert and os.path.exists(ROWS):
+        # merge: keep every committed row this invocation did not remeasure
+        fresh = {r["name"] for r in rows}
+        kept = []
+        with open(ROWS) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                old = json.loads(line)
+                if old.get("name") not in fresh:
+                    kept.append(old)
+        rows = kept + [{**row, "date": stamp} for row in rows]
+        with open(ROWS, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+    else:
+        with open(ROWS, "w") as f:
+            for row in rows:
+                f.write(json.dumps({**row, "date": stamp}) + "\n")
     with open(os.path.join(OUT, "README.md"), "w") as f:
         f.write(README)
     print(f"[dispatch] wrote {len(rows)} rows -> {ROWS}", flush=True)

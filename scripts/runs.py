@@ -148,6 +148,22 @@ def sweep_progress(
         "total": total,
         "last_cell": last.get("cell"),
     }
+    # batched-sweep amortization (telemetry/timeline.py): driver cells
+    # served from one compiled program share a `batch` key — report
+    # programs (batches + unbatched cells) and the cells-per-program
+    # ratio, instead of treating every batched cell as its own launch
+    seen_i = {}
+    for c in cells:
+        seen_i[c["i"]] = c  # dedupe re-registered records by progress idx
+    uniq = list(seen_i.values())
+    batched = [c for c in uniq if c.get("batch") is not None]
+    if batched:
+        batches = len({c["batch"] for c in batched})
+        programs = batches + (len(uniq) - len(batched))
+        out["batched_cells"] = len(batched)
+        out["batches"] = batches
+        if programs:
+            out["cells_per_program"] = round(len(uniq) / programs, 2)
     if last.get("ts") is not None:
         out["last_cell_ts"] = last["ts"]
         out["last_cell_age_s"] = round(time.time() - last["ts"], 1)

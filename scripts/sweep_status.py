@@ -55,12 +55,18 @@ def summarize_sweeps(
             c.get("sweep", "?"),
             {"cells": 0, "wall_s": 0.0, "compile_s": 0.0, "execute_s": 0.0,
              "errors": 0, "total": None, "last_cell": None, "last_ts": None,
-             "eta_s": None},
+             "eta_s": None, "batched_cells": 0, "batch_keys": set()},
         )
         fam["cells"] += 1
         fam["wall_s"] += c.get("wall_s", 0.0)
         fam["compile_s"] += c.get("compile_s", 0.0)
         fam["execute_s"] += c.get("execute_s", 0.0)
+        # batched-group accounting (telemetry/timeline.py): cells served
+        # from one compiled program share a `batch` key — count programs,
+        # not cells, when reporting compile amortization
+        if c.get("batch") is not None:
+            fam["batched_cells"] += 1
+            fam["batch_keys"].add(c["batch"])
         if c.get("ok") is False:
             fam["errors"] += 1
         if c.get("total") is not None:
@@ -86,6 +92,17 @@ def summarize_sweeps(
             "compile_s": round(fam["compile_s"], 3),
             "execute_s": round(fam["execute_s"], 3),
         }
+        # batched groups: cells-per-program is the compile-amortization
+        # ratio a warm-program sweep achieves (1.0 == fully sequential);
+        # programs = one per batch + one per unbatched cell
+        if fam["batched_cells"]:
+            batches = len(fam["batch_keys"])
+            programs = batches + (done - fam["batched_cells"])
+            row["batched_cells"] = fam["batched_cells"]
+            row["batches"] = batches
+            row["cells_per_program"] = (
+                round(done / programs, 2) if programs else None
+            )
         if fam["total"] is not None:
             row["total"] = fam["total"]
             row["frac"] = round(done / fam["total"], 4) if fam["total"] else None

@@ -617,16 +617,54 @@ def test_tier_b_covers_all_programs_and_invariants(tier_b_result):
     assert kinds == {
         "donation", "dtype_f64", "sharding_axis", "retrace_stability"
     }, kinds
-    for program in ("round", "block", "streaming", "async"):
+    for program in ("round", "block", "streaming", "async",
+                    "experiment_batch"):
         assert ("donation", program) in checks
         assert ("dtype_f64", program) in checks
         assert ("retrace_stability", program) in checks
     # the miscompile-guard axis check runs on the SHARDED trace of every
-    # body that builds a rank-2 client-axis value (both round bodies and
-    # the async buffer/lag-gather body)
+    # body that builds a rank-2 client-axis value (both round bodies, the
+    # async buffer/lag-gather body, and the experiment-axis map body)
     assert ("sharding_axis", "round_sharded") in checks
     assert ("sharding_axis", "streaming_sharded") in checks
     assert ("sharding_axis", "async_sharded") in checks
+    assert ("sharding_axis", "experiment_batch_sharded") in checks
+
+
+def test_tier_b_sharding_axis_fires_on_model_axis_in_experiment_map():
+    """The fire direction for the experiment-axis program's audit: a
+    model-axis constraint on a rank-2 value INSIDE the experiment
+    ``lax.map`` body must be caught (the walk descends into map/scan
+    sub-jaxprs — a constraint the batch axis hides from the top level is
+    exactly the regression this check exists for)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from blades_tpu.analysis.program_audit import check_sharding_axis
+    from blades_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        import pytest
+
+        pytest.skip("needs a >=2-device mesh")
+    mesh = make_mesh(devices[:2], (1, 2))
+
+    def bad_batched(stack):
+        def one(u):
+            with mesh:
+                return lax.with_sharding_constraint(
+                    u, jax.sharding.NamedSharding(mesh, P("clients", "model"))
+                )
+
+        return lax.map(one, stack)
+
+    closed = jax.make_jaxpr(bad_batched)(jnp.zeros((2, 8, 16)))
+    res = check_sharding_axis("experiment_batch_sharded", closed)
+    assert res["ok"] is False
+    assert "partitions axis>0" in res["detail"]
 
 
 def test_tier_b_donation_detail_names_the_alias_map(tier_b_result):

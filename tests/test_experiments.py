@@ -1,0 +1,337 @@
+"""Experiment-axis batch tests (blades_tpu/core/experiments.py): the
+load-bearing invariant — an S-experiment batch is BIT-identical to S
+sequential runs across the full aggregator registry, composes with
+run_block (scan-of-batched-rounds), and the whole batch is ONE compiled
+program (pinned via the telemetry compile counters)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.aggregators import AGGREGATORS, get_aggregator
+from blades_tpu.attackers import get_attack
+from blades_tpu.core import (
+    ClientOptSpec,
+    ExperimentBatch,
+    RoundEngine,
+    stack_experiments,
+    unstack_experiments,
+)
+from blades_tpu.ops.pytree import ravel
+
+EK, EF, EC = 6, 12, 4  # tiny linear fixture: registry-wide stays cheap
+
+
+def _tiny_loss(p, x, y, key):
+    logits = x.reshape(x.shape[0], -1) @ p["w"]
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+    top1 = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+    return loss, {"top1": top1}
+
+
+def _tiny_logits(p, x):
+    return x.reshape(x.shape[0], -1) @ p["w"]
+
+
+def _tiny_fixture(seed=0):
+    from blades_tpu.datasets.fl import FLDataset
+
+    rng = np.random.RandomState(seed)
+    ds = FLDataset(
+        rng.randn(EK, 20, EF).astype(np.float32),
+        rng.randint(0, EC, (EK, 20)).astype(np.int32),
+        np.full(EK, 20, np.int32),
+        rng.randn(30, EF).astype(np.float32),
+        rng.randint(0, EC, 30).astype(np.int32),
+    )
+    W0 = {"w": jnp.asarray(rng.randn(EF, EC).astype(np.float32) * 0.1)}
+    return ds, W0
+
+
+def _engine(W0, **kw):
+    defaults = dict(num_clients=EK, num_classes=EC)
+    defaults.update(kw)
+    return RoundEngine(_tiny_loss, _tiny_logits, W0, **defaults)
+
+
+def _flat(params):
+    return np.asarray(ravel(params))
+
+
+def test_stack_unstack_roundtrip():
+    trees = [{"a": jnp.arange(3) + i, "b": (jnp.ones(2) * i,)} for i in range(4)]
+    stacked = stack_experiments(trees)
+    assert stacked["a"].shape == (4, 3)
+    back = unstack_experiments(stacked)
+    for t, b in zip(trees, back):
+        for x, y in zip(jax.tree_util.tree_leaves(t),
+                        jax.tree_util.tree_leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_batch_matches_sequential_across_registry():
+    """The acceptance invariant: for EVERY registered aggregator (stateful
+    ones included — their state rides the stacked RoundState), an
+    S-experiment map-mode batch with per-experiment keys/lrs equals S
+    isolated run_round calls bit-for-bit: params, every carried state
+    leaf, every metric column."""
+    ds, W0 = _tiny_fixture()
+    S = 2
+    key = jax.random.PRNGKey(7)
+    keys = jax.random.split(key, S)
+    lrs = jnp.asarray([0.2, 0.05], jnp.float32)
+    slrs = jnp.ones(S, jnp.float32)
+    cx, cy = ds.sample_round(jax.random.fold_in(key, 23), 2, 4)
+
+    for name in sorted(AGGREGATORS):
+        agg_kws = (
+            {"num_byzantine": 2}
+            if name in ("trimmedmean", "krum", "multikrum", "dnc")
+            else {}
+        )
+        kw = dict(
+            aggregator=get_aggregator(name, **agg_kws),
+            num_byzantine=2,
+            attack=get_attack("ipm", epsilon=0.5),
+        )
+        if name == "fltrust":
+            trusted = np.zeros(EK, bool)
+            trusted[-1] = True
+            kw["trusted_mask"] = jnp.asarray(trusted)
+        eng = _engine(W0, **kw)
+
+        seq_states, seq_metrics = [], []
+        for s in range(S):
+            st = eng.init(W0)
+            st, m = eng.run_round(st, cx, cy, float(lrs[s]), 1.0, keys[s])
+            seq_states.append(st)
+            seq_metrics.append(m)
+
+        eb = ExperimentBatch(eng, S)
+        states = eb.init_batch(W0)
+        states, ms, _ = eb.run_round_batch(
+            states, cx, cy, lrs, slrs, keys, shared_data=True
+        )
+        outs = unstack_experiments(states, S)
+        for s in range(S):
+            np.testing.assert_array_equal(
+                _flat(seq_states[s].params), _flat(outs[s].params),
+                err_msg=f"{name}: experiment {s} params diverged",
+            )
+            for a, b in zip(jax.tree_util.tree_leaves(seq_states[s]),
+                            jax.tree_util.tree_leaves(outs[s])):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            for field, col in zip(seq_metrics[s], ms):
+                np.testing.assert_array_equal(
+                    np.asarray(field), np.asarray(col[s])
+                )
+
+
+def test_block_batch_matches_per_experiment_run_block():
+    """Scan-of-batched-rounds: run_block_batch column s equals that
+    experiment's own run_block (which is itself pinned bit-exact against
+    sequential rounds) — batch x block composition is a pure scheduling
+    choice."""
+    ds, W0 = _tiny_fixture(seed=1)
+    S, R = 3, 3
+    eng = _engine(W0, aggregator=get_aggregator("median"), num_byzantine=2,
+                  attack=get_attack("signflipping"))
+    key = jax.random.PRNGKey(5)
+    keys = jax.random.split(key, S)
+    dk = jax.random.fold_in(key, 23)
+    sample_keys = jnp.stack([
+        jnp.stack([jax.random.fold_in(jax.random.fold_in(dk, r), s)
+                   for s in range(S)])
+        for r in range(R)
+    ])
+    lrs = jnp.full((R, S), 0.1, jnp.float32)
+    sampler = ds.traceable_sampler(2, 4)
+
+    seq = []
+    for s in range(S):
+        st = eng.init(W0)
+        st, mm, _ = eng.run_block(
+            st, sample_keys[:, s], [0.1] * R, [1.0] * R, keys[s],
+            sampler=sampler,
+        )
+        seq.append((st, mm))
+
+    eb = ExperimentBatch(eng, S)
+    states = eb.init_batch(W0)
+    states, ms, _ = eb.run_block_batch(
+        states, sample_keys, lrs, jnp.ones((R, S), jnp.float32), keys,
+        sampler=sampler,
+    )
+    outs = unstack_experiments(states, S)
+    for s in range(S):
+        np.testing.assert_array_equal(_flat(seq[s][0].params),
+                                      _flat(outs[s].params))
+        for field, col in zip(seq[s][1], ms):
+            np.testing.assert_array_equal(
+                np.asarray(field), np.asarray(col[:, s])
+            )
+
+
+def test_batch_is_one_program_compile_pinned():
+    """The amortization contract: the S-experiment batch compiles ONE
+    program (vs S sequential programs it replaces), and a same-shape
+    recall adds ZERO compiles — the telemetry counters are the same
+    signal the Tier-B audit and the driver gate read."""
+    from blades_tpu.telemetry import (
+        Recorder,
+        get_recorder,
+        install_jax_monitoring,
+        set_recorder,
+    )
+
+    ds, W0 = _tiny_fixture(seed=2)
+    S = 3
+    eng = _engine(W0, aggregator=get_aggregator("mean"))
+    eb = ExperimentBatch(eng, S)
+    key = jax.random.PRNGKey(3)
+    keys = jax.random.split(key, S)
+    lrs = jnp.full((S,), 0.1, jnp.float32)
+    cx, cy = ds.sample_round(jax.random.fold_in(key, 1), 1, 4)
+
+    install_jax_monitoring()
+    prev = get_recorder()
+    rec = Recorder(path=None, enabled=True)
+    set_recorder(rec)
+    try:
+        def compiles():
+            return rec.counters.get("xla.compiles", 0)
+
+        before = compiles()
+        states = eb.init_batch(W0)
+        states, _, _ = eb.run_round_batch(
+            states, cx, cy, lrs, jnp.ones(S, jnp.float32), keys,
+            shared_data=True,
+        )
+        jax.block_until_ready(states.params)
+        first = compiles() - before
+        assert first >= 1  # the one batched program build
+
+        before = compiles()
+        states, _, _ = eb.run_round_batch(
+            states, cx, cy, lrs, jnp.ones(S, jnp.float32), keys,
+            shared_data=True,
+        )
+        jax.block_until_ready(states.params)
+        assert compiles() - before == 0  # warm recall: zero compiles
+        assert eb._round_jits[True]._cache_size() == 1
+    finally:
+        set_recorder(prev)
+
+
+def test_vmap_mode_allclose_and_one_program():
+    """The vmap schedule is numerically equivalent (NOT bit-identical —
+    batched training matmuls reassociate; measured on this backend) and
+    still one program per batch."""
+    ds, W0 = _tiny_fixture(seed=3)
+    S = 2
+    eng = _engine(W0, aggregator=get_aggregator("mean"))
+    key = jax.random.PRNGKey(11)
+    keys = jax.random.split(key, S)
+    lrs = jnp.asarray([0.1, 0.2], jnp.float32)
+    cx, cy = ds.sample_round(jax.random.fold_in(key, 1), 1, 4)
+
+    seq = []
+    for s in range(S):
+        st = eng.init(W0)
+        st, _ = eng.run_round(st, cx, cy, float(lrs[s]), 1.0, keys[s])
+        seq.append(_flat(st.params))
+
+    eb = ExperimentBatch(eng, S, mode="vmap")
+    states = eb.init_batch(W0)
+    states, _, _ = eb.run_round_batch(
+        states, cx, cy, lrs, jnp.ones(S, jnp.float32), keys,
+        shared_data=True,
+    )
+    outs = unstack_experiments(states, S)
+    for s in range(S):
+        np.testing.assert_allclose(
+            seq[s], _flat(outs[s].params), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_per_experiment_data_axis():
+    """[S, K, ...] per-experiment batches: each experiment trains on its
+    own draw, bit-identical to its isolated run."""
+    ds, W0 = _tiny_fixture(seed=4)
+    S = 2
+    eng = _engine(W0, aggregator=get_aggregator("median"))
+    key = jax.random.PRNGKey(13)
+    keys = jax.random.split(key, S)
+    draws = [ds.sample_round(jax.random.fold_in(key, 100 + s), 1, 4)
+             for s in range(S)]
+    lrs = jnp.full((S,), 0.1, jnp.float32)
+
+    seq = []
+    for s in range(S):
+        st = eng.init(W0)
+        st, _ = eng.run_round(st, *draws[s], 0.1, 1.0, keys[s])
+        seq.append(_flat(st.params))
+
+    eb = ExperimentBatch(eng, S)
+    cx = jnp.stack([d[0] for d in draws])
+    cy = jnp.stack([d[1] for d in draws])
+    states = eb.init_batch(W0)
+    states, _, _ = eb.run_round_batch(
+        states, cx, cy, lrs, jnp.ones(S, jnp.float32), keys,
+        shared_data=False,
+    )
+    outs = unstack_experiments(states, S)
+    for s in range(S):
+        np.testing.assert_array_equal(seq[s], _flat(outs[s].params))
+
+
+def test_diags_unstack_like_run_block():
+    """Installed surfaces (fault model here) come back stacked [S]-leading
+    and unstack per experiment, mirroring run_block's per-round diags."""
+    from blades_tpu.faults import FaultModel
+
+    ds, W0 = _tiny_fixture(seed=5)
+    S = 2
+    eng = _engine(
+        W0, aggregator=get_aggregator("median"),
+        fault_model=FaultModel(dropout_rate=0.3),
+    )
+    key = jax.random.PRNGKey(17)
+    keys = jax.random.split(key, S)
+    cx, cy = ds.sample_round(jax.random.fold_in(key, 1), 1, 4)
+    eb = ExperimentBatch(eng, S)
+    states = eb.init_batch(W0)
+    _, _, diags = eb.run_round_batch(
+        states, cx, cy, jnp.full((S,), 0.1, jnp.float32),
+        jnp.ones(S, jnp.float32), keys, shared_data=True,
+    )
+    assert diags["faults"] is not None
+    assert np.asarray(diags["faults"]["participants"]).shape == (S,)
+    assert diags["audit"] is None and diags["defense"] is None
+    per_exp = unstack_experiments(diags["faults"], S)
+    assert np.asarray(per_exp[0]["participants"]).shape == ()
+
+
+def test_validation_errors():
+    ds, W0 = _tiny_fixture(seed=6)
+    eng = _engine(W0, aggregator=get_aggregator("mean"))
+    with pytest.raises(ValueError, match="mode"):
+        ExperimentBatch(eng, 2, mode="pmap")
+    with pytest.raises(ValueError, match="num_experiments"):
+        ExperimentBatch(eng, 0)
+    eb = ExperimentBatch(eng, 2, mode="vmap")
+    with pytest.raises(ValueError, match="map"):
+        eb.run_block_batch((), jnp.zeros((1, 2, 2), jnp.uint32), (), (), (),
+                           sampler=lambda k: (k, k))
+    # S == K makes the shared-data inference ambiguous: must be explicit
+    eng6 = _engine(W0, aggregator=get_aggregator("mean"))
+    eb6 = ExperimentBatch(eng6, EK)
+    cx, cy = ds.sample_round(jax.random.PRNGKey(0), 1, 4)
+    with pytest.raises(ValueError, match="ambiguous"):
+        eb6.run_round_batch(
+            eb6.init_batch(W0), cx, cy,
+            jnp.full((EK,), 0.1, jnp.float32), jnp.ones(EK, jnp.float32),
+            jax.random.split(jax.random.PRNGKey(1), EK),
+        )

@@ -1,0 +1,388 @@
+"""Warm-program sweep serving tests (blades_tpu/sweeps + the batched
+certify driver): grouping correctness (different program shapes NEVER
+silently batch), batched == sequential bit-identity, batch-stamped sweep
+records, the engine cache, and the batched status rollups."""
+
+import argparse
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.aggregators import get_aggregator
+from blades_tpu.audit import (
+    QUICK_GRIDS,
+    battery_ctx,
+    search_cell,
+    synthetic_honest,
+)
+from blades_tpu.audit.attack_search import search_cells
+from blades_tpu.sweeps import (
+    EngineCache,
+    SweepCell,
+    group_key,
+    plan_groups,
+    program_fingerprint,
+    run_grouped,
+    static_fingerprint,
+)
+
+K, D, T = 6, 8, 2
+
+
+@pytest.fixture(scope="module")
+def trials():
+    return synthetic_honest(jax.random.PRNGKey(0), T, K, D)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return battery_ctx(None, K, D, key=jax.random.PRNGKey(3))
+
+
+# -- fingerprints / grouping ---------------------------------------------------
+
+
+def test_static_fingerprint_separates_aggregator_configs():
+    """Every constructor attribute participates by VALUE: an f-clamped
+    defense at a different f is a different program shape."""
+    a = static_fingerprint(get_aggregator("trimmedmean", num_byzantine=1))
+    b = static_fingerprint(get_aggregator("trimmedmean", num_byzantine=2))
+    c = static_fingerprint(get_aggregator("trimmedmean", num_byzantine=1))
+    assert a == c
+    assert a != b
+    # distinct classes never collide, even with empty attr dicts
+    assert static_fingerprint(get_aggregator("mean")) != static_fingerprint(
+        get_aggregator("median")
+    )
+
+
+def test_static_fingerprint_arrays_by_value():
+    x = np.arange(4, dtype=np.float32)
+    y = np.arange(4, dtype=np.float32)
+    z = y + 1
+    assert static_fingerprint(x) == static_fingerprint(y)
+    assert static_fingerprint(x) != static_fingerprint(z)
+
+
+def test_fault_model_fingerprint_collapses_traced_fill():
+    """NaN and Inf value-corruption configs are ONE program (the fill is a
+    traced state leaf) — and bitflip is not, and an unconfigured
+    corruption keeps its literal mode (the fill stays a compiled
+    constant there)."""
+    from blades_tpu.faults import FaultModel
+
+    nan = FaultModel(corrupt_clients=(1,), corrupt_mode="nan")
+    inf = FaultModel(corrupt_clients=(1,), corrupt_mode="inf")
+    bit = FaultModel(corrupt_clients=(1,), corrupt_mode="bitflip")
+    assert static_fingerprint(nan) == static_fingerprint(inf)
+    assert static_fingerprint(nan) != static_fingerprint(bit)
+    # no corruption configured -> mode stays literal (all-False mask,
+    # constant fill: programs differ, and neither is ever exercised)
+    off_nan = FaultModel(dropout_rate=0.3, corrupt_mode="nan")
+    off_inf = FaultModel(dropout_rate=0.3, corrupt_mode="inf")
+    assert static_fingerprint(off_nan) != static_fingerprint(off_inf)
+
+
+def test_plan_groups_never_mixes_program_shapes(trials, ctx):
+    """Cells with different K, different f-clamps (static aggregator
+    kwargs), different context structure, or different part-mask presence
+    land in different groups — grouping is by program shape, not by
+    label."""
+    small = synthetic_honest(jax.random.PRNGKey(1), T, 4, D)
+    cells = [
+        SweepCell("tm1/f1", get_aggregator("trimmedmean", num_byzantine=1),
+                  trials, 1, ctx),
+        SweepCell("tm1/f2", get_aggregator("trimmedmean", num_byzantine=1),
+                  trials, 2, ctx),
+        SweepCell("tm2", get_aggregator("trimmedmean", num_byzantine=2),
+                  trials, 2, ctx),
+        SweepCell("k4", get_aggregator("trimmedmean", num_byzantine=1),
+                  small, 1, battery_ctx(None, 4, D)),
+        SweepCell("masked", get_aggregator("trimmedmean", num_byzantine=1),
+                  trials, 1, ctx, part_mask=jnp.ones(K, bool)),
+        SweepCell("noctx", get_aggregator("trimmedmean", num_byzantine=1),
+                  trials, 1, {}),
+    ]
+    groups = plan_groups(cells)
+    assert [idx for _, idx in groups] == [[0, 1], [2], [3], [4], [5]]
+    # stateful defenses with different hyperparams separate too
+    s1 = SweepCell("cc1", get_aggregator("centeredclipping", tau=1.0),
+                   trials, 1, ctx)
+    s2 = SweepCell("cc2", get_aggregator("centeredclipping", tau=2.0),
+                   trials, 1, ctx)
+    assert group_key(s1) != group_key(s2)
+
+
+def test_search_cells_rejects_mixed_shapes(trials, ctx):
+    agg = get_aggregator("median")
+    small = synthetic_honest(jax.random.PRNGKey(1), T, 4, D)
+    with pytest.raises(ValueError, match="trial shape"):
+        search_cells(agg, [
+            dict(trials=trials, f=1, ctx=ctx, part_mask=None, label="a"),
+            dict(trials=small, f=1, ctx=ctx, part_mask=None, label="b"),
+        ], grids=QUICK_GRIDS)
+    with pytest.raises(ValueError, match="part-mask"):
+        search_cells(agg, [
+            dict(trials=trials, f=1, ctx=ctx, part_mask=None, label="a"),
+            dict(trials=trials, f=1, ctx=ctx,
+                 part_mask=jnp.ones(K, bool), label="b"),
+        ], grids=QUICK_GRIDS)
+
+
+# -- batched == sequential -----------------------------------------------------
+
+
+def test_batched_cells_bit_identical_to_sequential(trials, ctx):
+    """The serving contract: one grouped program produces the exact dicts
+    the per-cell programs produce, in input order."""
+    agg = get_aggregator("median")
+    cells = [
+        dict(trials=trials, f=f, ctx=ctx, part_mask=None, label=f"f{f}")
+        for f in range(3)
+    ]
+    batched = search_cells(agg, cells, grids=QUICK_GRIDS, use_jit=True)
+    for f in range(3):
+        solo = search_cell(agg, trials, f, ctx=ctx, grids=QUICK_GRIDS,
+                           use_jit=True)
+        assert batched[f] == solo
+
+
+def test_run_grouped_returns_input_order_and_walls(trials, ctx):
+    cells = [
+        SweepCell("m/f1", get_aggregator("median"), trials, 1, ctx),
+        SweepCell("tm/f1", get_aggregator("trimmedmean", num_byzantine=1),
+                  trials, 1, ctx),
+        SweepCell("m/f2", get_aggregator("median"), trials, 2, ctx),
+    ]
+    results, walls = run_grouped(cells, grids=QUICK_GRIDS, use_jit=True,
+                                 return_walls=True)
+    assert len(results) == len(walls) == 3
+    assert results[0] == search_cell(cells[0].agg, trials, 1, ctx=ctx,
+                                     grids=QUICK_GRIDS, use_jit=True)
+    assert results[2] == search_cell(cells[2].agg, trials, 2, ctx=ctx,
+                                     grids=QUICK_GRIDS, use_jit=True)
+    assert all(w > 0 for w in walls)
+    # grouped cells share one wall: the median pair split one group
+    assert walls[0] == walls[2]
+
+
+def test_batched_certify_slice_matches_sequential(tmp_path):
+    """End-to-end: the batched certify driver produces a bit-identical
+    matrix to the sequential path (timing fields stripped) on a mixed
+    slice with staleness columns, and reports itself as batched."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import certify
+
+    def mkargs(sequential):
+        return argparse.Namespace(
+            clients=6, dim=8, trials=2, seed=0, c=None,
+            aggs=["mean", "median"], quick=True, no_async=False,
+            tau_max=2, no_jit=False, sequential=sequential,
+            out=str(tmp_path),
+        )
+
+    seq = certify.certify_matrix(mkargs(True))
+    bat = certify.certify_matrix(mkargs(False))
+    assert seq["batched"] is False and bat["batched"] is True
+
+    def strip(m):
+        m = json.loads(json.dumps(m))
+        m.pop("batched")
+        for row in m["cells"] + m["async_cells"]:
+            row.pop("search_s")
+        return m
+
+    assert strip(seq) == strip(bat)
+
+
+# -- sweep records / rollups ---------------------------------------------------
+
+
+def test_batched_sweep_records_stamp_batch_and_validate(tmp_path, trials, ctx):
+    """Grouped cells emit one schema-valid `sweep` record each, sharing a
+    `batch` key with batch_size, amortized walls that sum to the group
+    wall, and counters on the first record only."""
+    from blades_tpu.telemetry import Recorder, get_recorder, set_recorder
+    from blades_tpu.telemetry.schema import validate_trace
+
+    trace = str(tmp_path / "trace.jsonl")
+    rec = Recorder(path=trace, enabled=True)
+    prev = get_recorder()
+    set_recorder(rec)
+    try:
+        search_cells(get_aggregator("median"), [
+            dict(trials=trials, f=f, ctx=ctx, part_mask=None, label=f"f{f}")
+            for f in range(3)
+        ], grids=QUICK_GRIDS, use_jit=True, batch_label="g1")
+    finally:
+        set_recorder(prev)
+        rec.close()
+    records = [json.loads(line) for line in open(trace) if line.strip()]
+    sweeps = [r for r in records if r.get("t") == "sweep"]
+    assert len(sweeps) == 3
+    assert all(r["batch"] == "g1" and r["batch_size"] == 3 for r in sweeps)
+    assert {r["cell"] for r in sweeps} == {"f0", "f1", "f2"}
+    errors = validate_trace(trace)
+    assert not errors, errors
+
+
+def test_sweep_status_reports_batched_groups():
+    """summarize_sweeps counts programs (batches + unbatched cells), not
+    cells, for the amortization ratio."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    from sweep_status import summarize_sweeps
+
+    records = [
+        {"t": "sweep", "sweep": "certify", "cell": f"c{i}", "wall_s": 1.0,
+         "execute_s": 0.5, "ts": 100.0 + i, "i": i + 1, "total": 6,
+         "batch": "b1" if i < 4 else None, "batch_size": 4 if i < 4 else None}
+        for i in range(6)
+    ]
+    for r in records:
+        if r["batch"] is None:
+            r.pop("batch")
+            r.pop("batch_size")
+    fam = summarize_sweeps(records)["sweeps"]["certify"]
+    assert fam["batched_cells"] == 4
+    assert fam["batches"] == 1
+    # 6 cells over (1 batch + 2 unbatched) = 3 programs
+    assert fam["cells_per_program"] == 2.0
+
+
+def test_runs_sweep_progress_reports_batches():
+    import sys
+    import time
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    from runs import sweep_progress
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        trace = os.path.join(td, "sweep_trace.jsonl")
+        now = time.time()
+        with open(trace, "w") as f:
+            for i in range(4):
+                f.write(json.dumps({
+                    "t": "sweep", "sweep": "certify", "cell": f"c{i}",
+                    "wall_s": 1.0, "ts": now, "i": i + 1, "total": 4,
+                    **({"batch": "g", "batch_size": 3} if i < 3 else {}),
+                }) + "\n")
+        trail = [{"artifacts": [trace]}]
+        out = sweep_progress(trail, repo=td)
+    assert out["cells_completed"] == 4
+    assert out["batched_cells"] == 3
+    assert out["batches"] == 1
+    assert out["cells_per_program"] == 2.0
+
+
+# -- engine cache --------------------------------------------------------------
+
+
+def test_engine_cache_hits_and_stats():
+    cache = EngineCache()
+    assert cache.get("k1") is None
+    cache.put("k1", "engine")
+    assert cache.get("k1") == "engine"
+    assert cache.stats() == {"entries": 1, "hits": 1, "misses": 1}
+
+
+def test_program_fingerprint_stable_across_equal_configs():
+    from blades_tpu.faults import FaultModel
+
+    a = program_fingerprint(
+        model="mlp", fault=FaultModel(corrupt_clients=(0,),
+                                      corrupt_mode="nan"),
+        agg=get_aggregator("median"),
+    )
+    b = program_fingerprint(
+        model="mlp", fault=FaultModel(corrupt_clients=(0,),
+                                      corrupt_mode="inf"),
+        agg=get_aggregator("median"),
+    )
+    c = program_fingerprint(
+        model="mlp", fault=FaultModel(corrupt_clients=(1,),
+                                      corrupt_mode="nan"),
+        agg=get_aggregator("median"),
+    )
+    assert a == b  # the traced-fill twins: one program
+    assert a != c  # victim ids are static constants: different program
+
+
+def test_simulator_engine_cache_twin_reuse(tmp_path):
+    """A Simulator pair differing only in nan<->inf corrupt fill shares
+    one warm engine (cache hit) and still lands bit-identical params —
+    the chaos inertness contract served from the cache."""
+    from blades_tpu.datasets import Synthetic
+    from blades_tpu.ops.pytree import ravel
+    from blades_tpu.simulator import Simulator
+
+    cache = EngineCache()
+    params = {}
+    for mode in ("nan", "inf"):
+        sim = Simulator(
+            dataset=Synthetic(num_clients=K, train_size=80, test_size=20,
+                              noise=0.3, cache=False),
+            aggregator="median",
+            log_path=str(tmp_path / mode),
+            seed=5,
+        )
+        sim.run(
+            "mlp", global_rounds=2, local_steps=1, train_batch_size=8,
+            client_lr=0.2, validate_interval=3,
+            fault_model={"corrupt_clients": [1], "corrupt_mode": mode},
+            engine_cache=cache,
+        )
+        params[mode] = np.asarray(ravel(sim.server.state.params))
+    assert cache.stats()["hits"] == 1
+    assert cache.stats()["misses"] == 1
+    np.testing.assert_array_equal(params["nan"], params["inf"])
+
+
+# -- the slow e2e: a mixed full slice ------------------------------------------
+
+
+@pytest.mark.slow
+def test_mixed_certify_slice_bit_identical_e2e(tmp_path):
+    """ROADMAP item 2's e2e: a mixed batch of sweep requests — stateful
+    defenses, f-clamped defenses, a configured variant, staleness
+    columns — through the warm-program batched driver returns
+    bit-identical JSON to the sequential path."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import certify
+
+    def mkargs(sequential):
+        return argparse.Namespace(
+            clients=8, dim=16, trials=2, seed=1, c=None,
+            aggs=["mean", "median", "trimmedmean", "krum",
+                  "centeredclipping", "clustering:distance",
+                  "byzantinesgd", "fltrust"],
+            quick=True, no_async=False, tau_max=3, no_jit=False,
+            sequential=sequential, out=str(tmp_path),
+        )
+
+    seq = certify.certify_matrix(mkargs(True))
+    bat = certify.certify_matrix(mkargs(False))
+
+    def strip(m):
+        m = json.loads(json.dumps(m))
+        m.pop("batched")
+        for row in m["cells"] + m["async_cells"]:
+            row.pop("search_s")
+        return m
+
+    assert strip(seq) == strip(bat)
