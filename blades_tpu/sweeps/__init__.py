@@ -59,6 +59,12 @@ __all__ = [
     "static_fingerprint",
 ]
 
+# the resilient execution layer (journaled resume, poison-cell
+# quarantine, deadlines/retry) lives in submodules to keep this module's
+# import surface minimal; import them as
+# ``from blades_tpu.sweeps.resilient import run_grouped_resilient`` and
+# ``from blades_tpu.sweeps.journal import SweepJournal``.
+
 
 # -- canonical config normalization -------------------------------------------
 
@@ -214,6 +220,38 @@ def plan_groups(
     return [(key, groups[key]) for key in order]
 
 
+def _execute_group(
+    group: Sequence[SweepCell],
+    key: str,
+    *,
+    grids: Optional[dict] = None,
+    use_jit: bool = True,
+):
+    """One batched execution of ``group`` (cells sharing program shape
+    ``key``) through :func:`~blades_tpu.audit.attack_search.search_cells`.
+    The single group-execution body shared by :func:`run_grouped` and the
+    resilient executor (``blades_tpu/sweeps/resilient.py``) — retry and
+    bisection re-enter exactly the call that failed, never a variant."""
+    from blades_tpu.audit.attack_search import search_cells
+
+    return search_cells(
+        group[0].agg,
+        [
+            {
+                "trials": c.trials,
+                "f": c.f,
+                "ctx": c.ctx,
+                "part_mask": c.part_mask,
+                "label": c.label,
+            }
+            for c in group
+        ],
+        grids=grids,
+        use_jit=use_jit,
+        batch_label=key,
+    )
+
+
 def run_grouped(
     cells: Sequence[SweepCell],
     *,
@@ -234,7 +272,6 @@ def run_grouped(
     together at the group boundary). The library-level ``attack_search``
     records carry the same batch stamps either way.
     """
-    from blades_tpu.audit.attack_search import search_cells
     from blades_tpu.telemetry import recorder as _trecorder
     from blades_tpu.telemetry.timeline import _counter_delta
 
@@ -246,27 +283,15 @@ def run_grouped(
         t0 = time.perf_counter()
         counters0 = _trecorder.process_counters()
         try:
-            outs = search_cells(
-                group[0].agg,
-                [
-                    {
-                        "trials": c.trials,
-                        "f": c.f,
-                        "ctx": c.ctx,
-                        "part_mask": c.part_mask,
-                        "label": c.label,
-                    }
-                    for c in group
-                ],
-                grids=grids,
-                use_jit=use_jit,
-                batch_label=key,
-            )
+            outs = _execute_group(group, key, grids=grids, use_jit=use_jit)
         except Exception as e:
             # a batched failure must still leave an attributable trail:
-            # one ok:false record per cell of the group (the sequential
-            # path's cell() context records errors on exit — a crashed
-            # batched sweep must not read as merely stuck)
+            # one ok:false record per cell of the group, carrying the
+            # exception type + message + the group's program fingerprint
+            # (the sequential path's cell() context records errors on
+            # exit — a crashed batched sweep must not read as merely
+            # stuck, and the failure must be attributable to a program
+            # shape, not just flagged)
             if sweep is not None:
                 wall = time.perf_counter() - t0
                 delta = _counter_delta(counters0)
@@ -278,6 +303,7 @@ def run_grouped(
                         batch=key,
                         batch_size=len(group),
                         error=f"{type(e).__name__}: {e}",
+                        error_type=type(e).__name__,
                     )
             raise
         wall = time.perf_counter() - t0

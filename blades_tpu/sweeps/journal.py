@@ -1,0 +1,270 @@
+"""Sweep result journal: the idempotent-resume substrate for the sweep
+drivers (``scripts/certify.py``, ``scripts/chaos.py``).
+
+On this box a multi-minute sweep dies for reasons that have nothing to do
+with the cells themselves — the TPU tunnel drops, the 1-core host starves
+the supervision heartbeat, an 8-device collective deadlocks (CLAUDE.md
+quirks) — and before this module a sweep killed at cell 180/208 restarted
+from zero. The per-cell ``sweep`` telemetry records already pin *which*
+cells completed (``telemetry/timeline.py``, flushed at every cell
+boundary); what they cannot carry is the cells' RESULT payloads — the
+telemetry schema is deliberately narrow. This journal is the companion
+artifact: one JSON line per completed cell with its full result dict,
+flushed at the same cell boundary, so a relaunch under ``BLADES_RESUME=1``
+recovers every completed cell's result and executes only the remainder.
+Merging is idempotent by construction: entries are keyed by cell label,
+last write wins, and a cell recovered from the journal contributes the
+byte-identical result dict the interrupted run computed.
+
+Validity: the journal header records a :func:`~blades_tpu.sweeps
+.program_fingerprint` of the sweep's configuration. A resume whose
+config fingerprint differs (different clients/seed/grids/pool) silently
+starts FRESH — merging results across configurations would fabricate a
+matrix no single run produced. Same discipline as the engine's
+checkpoint config guard (``utils/checkpoint.py``).
+
+Quarantined cells (``blades_tpu/sweeps/resilient.py``) are journaled too,
+with their attributable error instead of a result: a resumed sweep does
+NOT re-execute a quarantined cell — the poison that crashed it once will
+crash it again, and re-running it would turn every resume into a replay
+of the failure. Clearing the journal (a fresh, non-resume launch) is the
+retry-a-quarantined-cell path.
+
+Not a telemetry trace: records use a ``kind`` discriminator (not ``t``)
+and live next to — never inside — ``sweep_trace.jsonl``, so the
+schema-locked telemetry surface (SCHEMA001, ``docs/telemetry_schema
+.json``) stays closed while result payloads stay unconstrained.
+
+Reference counterpart: none — the reference runs one configuration per
+process and restarts any failure from scratch (``src/blades/
+simulator.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+__all__ = ["SweepJournal", "KILL_AT_ENV"]
+
+#: Test-only saboteur hook (tests/test_resilient.py, tests/test_chaos.py):
+#: when set to an integer N, the journal SIGKILLs its own process —
+#: exactly once, gated by a ``<journal>.kill_fired`` sentinel — right
+#: after the N-th cell line is durably on disk. This is how the
+#: kill-mid-sweep scenarios die at a *deterministic* cell boundary
+#: (mid-sweep, result persisted, process gone with no cleanup) instead of
+#: at a random instruction. Never set outside tests.
+KILL_AT_ENV = "BLADES_SWEEP_KILL_AT"
+
+
+class SweepJournal:
+    """Append-only per-cell result journal with fingerprint-guarded resume.
+
+    Usage (driver side)::
+
+        journal = SweepJournal(path, fingerprint=fp, resume=resumed)
+        done = journal.results()          # label -> result (maybe empty)
+        ... execute only cells not in `done` ...
+        journal.record(label, result, wall_s=w)   # at each cell boundary
+
+    ``resume=False`` (a fresh sweep) truncates any existing journal and
+    clears the kill sentinel; ``resume=True`` loads existing entries —
+    unless the stored fingerprint mismatches ``fingerprint``, in which
+    case the journal resets and :attr:`resumed` stays False (the caller
+    can report why nothing was recovered).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        fingerprint: Optional[str] = None,
+        resume: bool = False,
+    ):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.resumed = False
+        self._entries: Dict[str, Dict[str, Any]] = {}
+        self._quarantined: Dict[str, Dict[str, Any]] = {}
+        self._fh = None
+        if resume and os.path.exists(path):
+            loaded = _load_lines(path)
+            meta = next((r for r in loaded if r.get("kind") == "meta"), None)
+            if meta is not None and (
+                fingerprint is None or meta.get("fp") == fingerprint
+            ):
+                for r in loaded:
+                    if r.get("kind") == "cell" and "cell" in r:
+                        self._entries[r["cell"]] = r
+                    elif r.get("kind") == "quarantine" and "cell" in r:
+                        self._quarantined[r["cell"]] = r
+                self.resumed = True
+        if not self.resumed:
+            self._reset()
+        self._open()
+        if not self.resumed:
+            self._append({
+                "kind": "meta",
+                "fp": fingerprint,
+                "ts": time.time(),
+                "pid": os.getpid(),
+            })
+
+    # -- state ---------------------------------------------------------------
+
+    def results(self) -> Dict[str, Any]:
+        """label -> recovered result dict (completed cells only)."""
+        return {k: v["result"] for k, v in self._entries.items()}
+
+    def entry(self, label: str) -> Optional[Dict[str, Any]]:
+        """The full journal entry for one completed cell (result + wall),
+        or None."""
+        return self._entries.get(label)
+
+    def quarantined(self) -> Dict[str, Dict[str, Any]]:
+        """label -> quarantine entry (error, error_type, batch)."""
+        return dict(self._quarantined)
+
+    def has(self, label: str) -> bool:
+        """True when ``label`` needs no execution on resume: either its
+        result was recovered or it was quarantined (re-running a poison
+        cell replays the failure; see the module docstring)."""
+        return label in self._entries or label in self._quarantined
+
+    def recovered(self, labels: Iterable[str]) -> List[str]:
+        """The subset of ``labels`` the journal can satisfy, input order."""
+        return [lab for lab in labels if self.has(lab)]
+
+    def __len__(self) -> int:
+        return len(self._entries) + len(self._quarantined)
+
+    # -- recording -----------------------------------------------------------
+
+    def record(
+        self, label: str, result: Any, wall_s: float = 0.0, **extra
+    ) -> None:
+        """Journal one completed cell (flushed immediately — the journal's
+        whole point is surviving a SIGKILL at the very next instruction)."""
+        entry = {
+            "kind": "cell",
+            "cell": str(label),
+            "ts": time.time(),
+            "wall_s": round(float(wall_s), 6),
+            "result": result,
+            **extra,
+        }
+        self._entries[str(label)] = entry
+        self._append(entry)
+        self._maybe_kill()
+
+    def record_quarantine(
+        self,
+        label: str,
+        error: str,
+        error_type: str,
+        batch: Optional[str] = None,
+        attempts: Optional[int] = None,
+    ) -> None:
+        """Journal one quarantined cell with its attributable error."""
+        entry = {
+            "kind": "quarantine",
+            "cell": str(label),
+            "ts": time.time(),
+            "error": str(error)[:500],
+            "error_type": str(error_type),
+        }
+        if batch is not None:
+            entry["batch"] = batch
+        if attempts is not None:
+            entry["attempts"] = int(attempts)
+        self._quarantined[str(label)] = entry
+        self._append(entry)
+        self._maybe_kill()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    # -- internals -----------------------------------------------------------
+
+    @property
+    def _sentinel(self) -> str:
+        return self.path + ".kill_fired"
+
+    def _reset(self) -> None:
+        for p in (self.path, self._sentinel):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def _open(self) -> None:
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh = open(self.path, "a")
+
+    def _append(self, entry: Dict[str, Any]) -> None:
+        if self._fh is None:
+            self._open()
+        self._fh.write(json.dumps(entry, default=_json_default) + "\n")
+        # flush through the Python buffer at every cell boundary: data in
+        # the OS page cache survives SIGKILL; data in the interpreter does
+        # not. Cells run seconds-to-minutes — one flush each is the
+        # existing once-per-round discipline, not a hot path.
+        self._fh.flush()
+
+    def _maybe_kill(self) -> None:
+        """The test saboteur (see :data:`KILL_AT_ENV`)."""
+        kill_at = os.environ.get(KILL_AT_ENV)
+        if not kill_at:
+            return
+        try:
+            if len(self) != int(kill_at):
+                return
+        except ValueError:
+            return
+        if os.path.exists(self._sentinel):
+            return
+        open(self._sentinel, "w").close()
+        os.kill(os.getpid(), signal.SIGKILL)  # no autosave, no cleanup
+
+
+def _load_lines(path: str) -> List[Dict[str, Any]]:
+    """Parse the journal, skipping blank/torn lines (the writer may have
+    been SIGKILLed mid-append — the torn tail is exactly the crash this
+    journal exists to survive)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
+
+
+def _json_default(obj):
+    """Serialize numpy/jax scalars embedded in result dicts without
+    importing either (same tolerance as the telemetry recorder)."""
+    for attr in ("item", "tolist"):
+        if hasattr(obj, attr):
+            try:
+                return getattr(obj, attr)()
+            except Exception:  # noqa: BLE001 - fall through to repr
+                pass
+    return repr(obj)

@@ -1,0 +1,531 @@
+"""Resilient sweep execution: retry, deadlines, poison-cell quarantine,
+journaled resume.
+
+The grouped executor (:func:`blades_tpu.sweeps.run_grouped`) made the
+cert/chaos sweeps fast (one compiled program per program-shape group,
+PR 12) but brittle in exactly the dimension this box punishes: one
+failing cell in a batched group re-raised after stamping ok:false on
+every sibling — a whole group's results lost to one poison cell — and
+any process death restarted the sweep from zero. This module is the
+robustness layer around it, the request-level failure isolation the
+ROADMAP's sweep server (item 2) needs before it can serve traffic:
+
+- **Bounded-backoff retry** — a failed execution is retried on the
+  shared :func:`~blades_tpu.utils.retry.backoff_delay` curve (the same
+  curve the in-process host retries and the supervisor's relaunch budget
+  degrade on), with each retry emitted as a schema-locked ``retry``
+  record. Timing and compile counters restart per attempt, so a failed
+  try's wall and the backoff sleep never pollute the successful
+  attempt's accounting. Transient failures (tunnel flake,
+  collective-rendezvous deadlock, Unavailable-class backend errors) heal
+  without losing work.
+
+- **Per-cell deadlines** (:func:`soft_deadline`) — an execution of C
+  cells is bounded by ``cell_deadline_s x C``. Soft by design: SIGALRM
+  can only interrupt the interpreter between bytecodes, so a launch stuck
+  inside an XLA collective trips the deadline when control returns (or
+  never — the supervision heartbeat watchdog is the HARD layer that kills
+  the whole process group; docs/robustness.md "Resumable sweeps" sizes
+  the two against each other). A tripped deadline is an ordinary
+  retryable failure: retry, then degrade.
+
+- **Quarantine by bisection** — when a batched group's retry budget is
+  exhausted, the group is split and each half re-executed (the halves
+  re-enter the same :func:`~blades_tpu.sweeps._execute_group` body),
+  recursively, so a poison cell is isolated while every innocent
+  sibling's result is salvaged by the largest passing subgroups. The
+  isolated cell gets a final per-cell retry, then a ``quarantine``
+  record carrying the exception type + message + the group's program
+  fingerprint — an attributable failure, not a flag — and the sweep
+  moves on. This is the degrade ladder batched -> subgroup ->
+  sequential -> quarantine.
+
+- **Journaled resume** — every completed cell's result is appended to a
+  :class:`~blades_tpu.sweeps.journal.SweepJournal` at the cell boundary
+  (journal first, telemetry second: a crash between the two re-executes
+  the cell rather than losing it). A relaunch under ``BLADES_RESUME=1``
+  recovers completed (and quarantined) cells from the journal and
+  executes only the remainder; recovered cells re-emit zero-wall
+  ``resumed: true`` sweep records so the i-of-N progress trail stays
+  monotone and a resumed sweep is distinguishable from a clean one
+  (``scripts/sweep_status.py``).
+
+Two executors share ONE set of record-emitting primitives
+(``_emit_retry`` / ``_quarantine_cell`` / ``_recover_cell``), so their
+trails are identical by construction: :func:`run_grouped_resilient` for
+batched program-shape groups (certify's default path) and
+:func:`run_cells_resilient` for sweeps whose cells are already their own
+execution unit (chaos seeds, certify ``--sequential``).
+
+Failure semantics of the result list: a quarantined cell's slot is
+``None`` (drivers render it as an attributable quarantined row, never a
+fabricated result); every other slot is the bit-identical result the
+plain executor would have produced — re-execution paths re-enter the
+same traced body, so salvage never changes numbers
+(``tests/test_resilient.py``).
+
+Reference counterpart: none — the reference assumes a permanently
+healthy Ray cluster and has no sweep machinery at all
+(``src/blades/simulator.py:189-211``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import signal
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from blades_tpu.sweeps import SweepCell, _execute_group, plan_groups
+from blades_tpu.sweeps.journal import SweepJournal
+from blades_tpu.telemetry import recorder as _trecorder
+from blades_tpu.telemetry.timeline import _counter_delta
+from blades_tpu.utils.retry import backoff_delay
+
+__all__ = [
+    "DeadlineExceeded",
+    "ResilienceOptions",
+    "ResilienceReport",
+    "run_cells_resilient",
+    "run_grouped_resilient",
+    "soft_deadline",
+]
+
+
+class DeadlineExceeded(Exception):
+    """A sweep cell/group execution overran its soft deadline."""
+
+
+def _alarm_usable() -> bool:
+    return (
+        hasattr(signal, "setitimer")
+        and threading.current_thread() is threading.main_thread()
+    )
+
+
+@contextmanager
+def soft_deadline(seconds: Optional[float]):
+    """Raise :class:`DeadlineExceeded` in the calling (main) thread after
+    ``seconds``. Best-effort: the SIGALRM handler runs at the next
+    interpreter bytecode, so pure-C blocking (an XLA execute, a stuck
+    collective) trips late or not at all — the supervision watchdog owns
+    the hard kill. ``None``/``0``, or a non-main-thread caller, disables
+    the deadline entirely (yields ``False``)."""
+    if not seconds or seconds <= 0 or not _alarm_usable():
+        yield False
+        return
+
+    def _trip(signum, frame):
+        raise DeadlineExceeded(f"exceeded soft deadline of {seconds:.1f}s")
+
+    prev = signal.signal(signal.SIGALRM, _trip)
+    signal.setitimer(signal.ITIMER_REAL, float(seconds))
+    try:
+        yield True
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+@dataclasses.dataclass
+class ResilienceOptions:
+    """Knobs for the resilient executors.
+
+    ``attempts`` is the retry budget per *execution unit*: a full
+    batched group gets it, bisection halves get one attempt each (the
+    transient-flake budget was already spent at group level — a half
+    failing twice in a row is a poison signal, not weather), and
+    isolated single cells get it again before quarantine.
+    ``cell_deadline_s`` scales with the subgroup: a group of C cells
+    gets ``C x cell_deadline_s``. ``sleep`` and ``runner`` are test
+    injection points (``runner(group, key)`` replaces the real batched
+    execution)."""
+
+    attempts: int = 2
+    base_delay_s: float = 0.5
+    max_delay_s: float = 30.0
+    cell_deadline_s: Optional[float] = None
+    sleep: Callable[[float], None] = time.sleep
+    runner: Optional[Callable[[Sequence[SweepCell], str], list]] = None
+
+    def __post_init__(self):
+        # a non-positive budget would skip the attempt loop entirely and
+        # quarantine every cell with a fabricated error — and the
+        # poisoned quarantines would persist in the journal
+        self.attempts = max(1, int(self.attempts))
+
+
+@dataclasses.dataclass
+class ResilienceReport:
+    """What the resilient executor had to do beyond plain execution —
+    the numbers a degraded/resumed sweep must surface (driver summaries,
+    ``sweep_status``): a sweep that retried its way through is NOT the
+    same evidence as one that ran clean."""
+
+    retried: int = 0
+    degraded_groups: int = 0
+    executed: int = 0
+    resumed_skipped: int = 0
+    quarantined: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list
+    )
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "executed": self.executed,
+            "resumed_skipped": self.resumed_skipped,
+            "retried": self.retried,
+            "degraded_groups": self.degraded_groups,
+            "quarantined": [q["cell"] for q in self.quarantined],
+        }
+
+
+# -- the shared record-emitting primitives ------------------------------------
+# One implementation each, used by BOTH executors, so retry/quarantine/
+# resume trails are identical across the batched and per-cell paths by
+# construction (the docstring contract tests/test_resilient.py pins).
+
+
+def _emit_retry(
+    rec, report: ResilienceReport, kind: str, *, what: str, attempt: int,
+    delay: float, exc: BaseException, batch: Optional[str] = None,
+    cell: Optional[str] = None,
+) -> None:
+    report.retried += 1
+    fields: Dict[str, Any] = {"sweep": kind}
+    if batch is not None:
+        fields["batch"] = batch
+    if cell is not None:
+        fields["cell"] = cell
+    rec.event(
+        "retry",
+        what=what,
+        attempt=attempt,
+        delay_s=delay,
+        error=f"{type(exc).__name__}: {exc}"[:300],
+        **fields,
+    )
+    rec.flush()  # a live status query must see the retry
+
+
+def _quarantine_cell(
+    rec, sweep, journal: Optional[SweepJournal], report: ResilienceReport,
+    kind: str, label: str, exc: BaseException, *, attempts: int,
+    batch: Optional[str] = None, wall: float = 0.0,
+    delta: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Quarantine one cell: journal entry (a resume must not replay the
+    poison), ``quarantine`` event, and a flagged ok:false driver record
+    carrying the FINAL attempt's wall and compile counters — the failure
+    cost stays visible in the sweep accounting."""
+    error = f"{type(exc).__name__}: {exc}"[:300]
+    info = {
+        "cell": label,
+        "error": error,
+        "error_type": type(exc).__name__,
+        "batch": batch,
+        "attempts": attempts,
+    }
+    report.quarantined.append(info)
+    if journal is not None:
+        journal.record_quarantine(
+            label, error, info["error_type"], batch=batch, attempts=attempts,
+        )
+    event: Dict[str, Any] = {
+        "sweep": kind,
+        "cell": label,
+        "ts": time.time(),
+        "error": error,
+        "error_type": info["error_type"],
+        "attempts": attempts,
+    }
+    if batch is not None:
+        event["batch"] = batch
+    rec.event("quarantine", **event)
+    if sweep is not None:
+        extra = {"batch": batch} if batch is not None else {}
+        sweep.record(
+            label, wall, counter_delta=delta, error=error,
+            error_type=info["error_type"], quarantined=True, **extra,
+        )
+    else:
+        rec.flush()
+
+
+def _recover_cell(
+    journal: SweepJournal, sweep, report: ResilienceReport, label: str,
+    *, batch: Optional[str] = None,
+):
+    """Recover one journaled cell on resume; returns ``(result, wall)``
+    (``(None, 0.0)`` for a journaled quarantine). Re-emits a zero-wall
+    ``resumed: true`` driver record — the interrupted attempt already
+    recorded (or lost) the real wall; double-stamping it would inflate
+    every cross-attempt rollup."""
+    report.resumed_skipped += 1
+    extra = {"batch": batch} if batch is not None else {}
+    entry = journal.entry(label)
+    if entry is not None:
+        if sweep is not None:
+            sweep.record(label, 0.0, resumed=True, **extra)
+        return entry["result"], float(entry.get("wall_s", 0.0))
+    q = journal.quarantined()[label]
+    report.quarantined.append({
+        "cell": label,
+        "error": q.get("error", ""),
+        "error_type": q.get("error_type", "Exception"),
+        "batch": q.get("batch", batch),
+        "attempts": q.get("attempts"),
+    })
+    if sweep is not None:
+        sweep.record(
+            label, 0.0, resumed=True, quarantined=True,
+            error=q.get("error", ""),
+            error_type=q.get("error_type", "Exception"),
+            **extra,
+        )
+    return None, 0.0
+
+
+# -- the per-cell executor ----------------------------------------------------
+
+
+def run_cells_resilient(
+    cells,
+    run_cell: Callable[[Any], Any],
+    *,
+    sweep=None,
+    journal: Optional[SweepJournal] = None,
+    options: Optional[ResilienceOptions] = None,
+    kind: Optional[str] = None,
+):
+    """The per-cell resilient loop for NON-batched sweeps — the degrade
+    ladder without bisection, since each cell is already its own
+    execution unit: journal recovery, per-attempt retry, soft deadline,
+    quarantine, all through the shared primitives above.
+
+    ``scripts/chaos.py`` (one seed per cell) and ``scripts/certify.py
+    --sequential`` (one search program per cell) both route through it.
+
+    ``cells``: a sequence of ``(label, payload)``; ``run_cell(payload)``
+    executes one cell and returns its (JSON-serializable) result.
+    Returns ``(results, walls, report)`` like
+    :func:`run_grouped_resilient` — a quarantined cell's slot is None.
+    """
+    options = options or ResilienceOptions()
+    cells = list(cells)
+    kind = kind or getattr(sweep, "kind", "sweep")
+    rec = getattr(sweep, "rec", None) or _trecorder.get_recorder()
+    results: List[Any] = []
+    walls: List[float] = []
+    report = ResilienceReport()
+
+    for label, payload in cells:
+        if journal is not None and journal.has(label):
+            result, wall = _recover_cell(journal, sweep, report, label)
+            results.append(result)
+            walls.append(wall)
+            continue
+
+        ok = False
+        out = None
+        last: Optional[BaseException] = None
+        wall = 0.0
+        delta: Dict[str, Any] = {}
+        for attempt in range(1, options.attempts + 1):
+            t0 = time.perf_counter()
+            counters0 = _trecorder.process_counters()
+            try:
+                with soft_deadline(options.cell_deadline_s):
+                    out = run_cell(payload)
+                wall = time.perf_counter() - t0
+                delta = _counter_delta(counters0)
+                ok = True
+                break
+            except Exception as e:  # noqa: BLE001 - quarantine, keep going
+                last = e
+                wall = time.perf_counter() - t0
+                delta = _counter_delta(counters0)
+                if attempt == options.attempts:
+                    break
+                delay = backoff_delay(
+                    attempt, options.base_delay_s, options.max_delay_s
+                )
+                _emit_retry(
+                    rec, report, kind, what="sweep_cell", attempt=attempt,
+                    delay=delay, exc=e, cell=label,
+                )
+                options.sleep(delay)
+
+        if not ok:
+            assert last is not None
+            _quarantine_cell(
+                rec, sweep, journal, report, kind, label, last,
+                attempts=options.attempts, wall=wall, delta=delta,
+            )
+            results.append(None)
+            walls.append(wall)
+            continue
+
+        if journal is not None:
+            journal.record(label, out, wall_s=wall)
+        if sweep is not None:
+            extra = {"retries": attempt - 1} if attempt > 1 else {}
+            sweep.record(label, wall, counter_delta=delta, **extra)
+        results.append(out)
+        walls.append(wall)
+        report.executed += 1
+
+    return results, walls, report
+
+
+# -- the batched (program-shape grouped) executor -----------------------------
+
+
+def run_grouped_resilient(
+    cells: Sequence[SweepCell],
+    *,
+    grids: Optional[dict] = None,
+    use_jit: bool = True,
+    sweep=None,
+    journal: Optional[SweepJournal] = None,
+    options: Optional[ResilienceOptions] = None,
+):
+    """Execute attack-search cells grouped by program shape, resiliently.
+
+    Drop-in for :func:`blades_tpu.sweeps.run_grouped(..., return_walls=
+    True)` with a third return value: ``(results, walls, report)``.
+    Results come back in input order; a quarantined cell's slot is
+    ``None``; on a clean run with an empty journal the executed programs
+    — and therefore the results — are identical to the plain executor's.
+
+    ``sweep``: the driver's :class:`~blades_tpu.telemetry.timeline
+    .SweepAccounting` (or None). ``journal``: a
+    :class:`~blades_tpu.sweeps.journal.SweepJournal`; cells it already
+    holds are recovered, every newly completed cell is journaled at its
+    boundary. ``options``: :class:`ResilienceOptions`.
+    """
+    options = options or ResilienceOptions()
+    cells = list(cells)
+    results: List[Optional[Dict[str, Any]]] = [None] * len(cells)
+    walls: List[float] = [0.0] * len(cells)
+    report = ResilienceReport()
+    kind = getattr(sweep, "kind", "sweep")
+    rec = getattr(sweep, "rec", None) or _trecorder.get_recorder()
+    runner = options.runner or (
+        lambda group, key: _execute_group(
+            group, key, grids=grids, use_jit=use_jit
+        )
+    )
+
+    def _attempt(idxs: List[int], key: str, attempts: int, fail: dict):
+        """Run one subgroup with retry; returns (outs, wall, delta,
+        retries_used) or raises the final failure, leaving the final
+        attempt's wall/counters in ``fail`` so the quarantine record can
+        carry the real failure cost."""
+        group = [cells[i] for i in idxs]
+        ddl = (
+            options.cell_deadline_s * len(group)
+            if options.cell_deadline_s
+            else None
+        )
+        last: Optional[BaseException] = None
+        for attempt in range(1, attempts + 1):
+            t0 = time.perf_counter()
+            counters0 = _trecorder.process_counters()
+            try:
+                with soft_deadline(ddl):
+                    outs = runner(group, key)
+                wall = time.perf_counter() - t0
+                return outs, wall, _counter_delta(counters0), attempt - 1
+            except Exception as e:  # noqa: BLE001 - every failure degrades
+                last = e
+                fail["wall"] = time.perf_counter() - t0
+                fail["delta"] = _counter_delta(counters0)
+                if attempt == attempts:
+                    break
+                delay = backoff_delay(
+                    attempt, options.base_delay_s, options.max_delay_s
+                )
+                _emit_retry(
+                    rec, report, kind,
+                    what="sweep_group" if len(group) > 1 else "sweep_cell",
+                    attempt=attempt, delay=delay, exc=e, batch=key,
+                    cell=group[0].label if len(group) == 1 else None,
+                )
+                options.sleep(delay)
+        assert last is not None
+        raise last
+
+    def _commit(idxs, outs, wall, delta, key, retries_used):
+        share = wall / len(idxs)
+        exec_share = max(
+            0.0,
+            wall - delta.get("compile_s", 0.0) - delta.get("trace_s", 0.0),
+        ) / len(idxs)
+        for j, (i, out) in enumerate(zip(idxs, outs)):
+            c = cells[i]
+            results[i] = out
+            walls[i] = share
+            # journal FIRST: a crash between journal append and telemetry
+            # flush re-executes the cell on resume; the reverse order
+            # would mark it done with no recoverable result
+            if journal is not None:
+                journal.record(c.label, out, wall_s=share)
+            if sweep is not None:
+                extra = {"retries": retries_used} if retries_used else {}
+                sweep.record(
+                    c.label,
+                    share,
+                    counter_delta=delta if j == 0 else None,
+                    execute_s=round(exec_share, 6),
+                    batch=key,
+                    batch_size=len(idxs),
+                    **extra,
+                )
+        report.executed += len(idxs)
+
+    def _solve(idxs: List[int], key: str, attempts: int):
+        fail: dict = {}
+        try:
+            outs, wall, delta, retries_used = _attempt(
+                idxs, key, attempts, fail,
+            )
+        except Exception as e:  # noqa: BLE001 - isolate, salvage, move on
+            if len(idxs) == 1:
+                _quarantine_cell(
+                    rec, sweep, journal, report, kind,
+                    cells[idxs[0]].label, e, attempts=attempts, batch=key,
+                    wall=fail.get("wall", 0.0), delta=fail.get("delta"),
+                )
+                return
+            # bisect: isolate the poison cell(s), salvage the siblings in
+            # the largest passing subgroups (halves get one attempt —
+            # the transient budget was spent above; singletons get the
+            # full per-cell budget before quarantine)
+            report.degraded_groups += 1
+            mid = len(idxs) // 2
+            for half in (idxs[:mid], idxs[mid:]):
+                _solve(
+                    half,
+                    key,
+                    options.attempts if len(half) == 1 else 1,
+                )
+            return
+        _commit(idxs, outs, wall, delta, key, retries_used)
+
+    for key, idxs in plan_groups(cells):
+        pending: List[int] = []
+        for i in idxs:
+            c = cells[i]
+            if journal is not None and journal.has(c.label):
+                results[i], walls[i] = _recover_cell(
+                    journal, sweep, report, c.label, batch=key,
+                )
+            else:
+                pending.append(i)
+        if pending:
+            _solve(pending, key, options.attempts)
+
+    return results, walls, report

@@ -59,8 +59,10 @@ telemetry (``BLADES_TELEMETRY=0``) reduces every hook to an attribute
 check and an early return — zero clock reads, zero records, zero added
 compiles (pinned in ``tests/test_timeline.py``).
 
-Record schemas: ``docs/telemetry_schema.json`` v3 (``timeline``,
-``sweep``); prose in ``docs/observability.md`` "Dispatch accounting".
+Record schemas: ``docs/telemetry_schema.json`` v4 (``timeline``,
+``sweep``, plus the resilient-sweep ``retry``/``quarantine``/``resume``
+emitters in ``blades_tpu/sweeps/resilient.py``); prose in
+``docs/observability.md`` "Dispatch accounting".
 Reference counterpart: none — the reference records only whole-round
 wall time (``src/blades/simulator.py:453-455``); it cannot say whether a
 slow round is host- or device-bound.
@@ -275,6 +277,33 @@ class SweepAccounting:
         # must still be queryable by sweep_status
         self.rec.flush()
 
+    def resume(
+        self,
+        skipped: int,
+        journal: Optional[str] = None,
+        quarantined: int = 0,
+    ) -> None:
+        """Mark this attempt as a journaled resume (``blades_tpu/sweeps/
+        journal.py``): emit one ``resume`` record — how many cells were
+        recovered instead of executed. The executor then re-emits each
+        recovered cell as a zero-wall ``resumed: true`` sweep record (the
+        interrupted attempt recorded the real wall), so the i-of-N trail
+        stays monotone and a resumed sweep is distinguishable from a
+        clean one at every surface (``scripts/sweep_status.py``,
+        ``scripts/runs.py``)."""
+        fields: Dict[str, Any] = {
+            "sweep": self.kind,
+            "skipped": int(skipped),
+            "total": self.total,
+            "ts": time.time(),
+        }
+        if quarantined:
+            fields["quarantined"] = int(quarantined)
+        if journal:
+            fields["journal"] = journal
+        self.rec.event("resume", **fields)
+        self.rec.flush()
+
     def cell(self, key: str, **fields):
         """Context manager accounting one sweep cell (``fields`` are extra
         static labels copied onto the record, schema-permitting)."""
@@ -305,6 +334,7 @@ class SweepAccounting:
     def _emit(
         self, key: str, wall: float, delta: Dict[str, Any], fields: dict,
         error: Optional[str] = None,
+        error_type: Optional[str] = None,
     ) -> None:
         self.done += 1
         rate = (time.perf_counter() - self._t0) / max(self.done, 1)
@@ -329,6 +359,8 @@ class SweepAccounting:
         if error is not None:
             rec_fields["ok"] = False
             rec_fields["error"] = error[:300]
+            if error_type is not None:
+                rec_fields.setdefault("error_type", error_type)
         self.rec.event("sweep", **rec_fields)
         # cell boundary: one buffered trace write + one heartbeat touch —
         # a supervised sweep's liveness signal between Simulator flushes
@@ -374,6 +406,7 @@ class _Cell:
             error=(
                 f"{exc_type.__name__}: {exc}" if exc_type is not None else None
             ),
+            error_type=exc_type.__name__ if exc_type is not None else None,
         )
         return False
 

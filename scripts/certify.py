@@ -130,7 +130,7 @@ def _battery_entry(agg, f_nom, res) -> dict:
     }
 
 
-def certify_matrix(args, sweep=None) -> dict:
+def certify_matrix(args, sweep=None, journal=None, resilience=None) -> dict:
     """The full certification matrix. Default: the WARM-PROGRAM batched
     sweep — every attack-search cell (battery resilience, breakdown,
     staleness columns) becomes a :class:`blades_tpu.sweeps.SweepCell`,
@@ -140,7 +140,19 @@ def certify_matrix(args, sweep=None) -> dict:
     trace+compile PR 11 measured. Results are bit-identical to the
     sequential path (``--sequential``; the map body is the same trace —
     pinned by ``tests/test_sweeps.py``); only the ``search_s`` timing
-    fields differ (amortized group wall per cell vs per-cell wall)."""
+    fields differ (amortized group wall per cell vs per-cell wall).
+
+    Fault tolerance (``blades_tpu/sweeps/resilient.py``): the batched
+    path runs under the resilient executor — failed groups retry on the
+    shared backoff curve, poison cells are isolated by bisection and
+    quarantined with an attributable error while every sibling's result
+    is salvaged, and with a ``journal``
+    (:class:`blades_tpu.sweeps.journal.SweepJournal`) completed cells
+    are persisted at each cell boundary and recovered on a
+    ``BLADES_RESUME=1`` relaunch — the resumed matrix merges journaled
+    and freshly-executed cells into content identical (modulo the
+    timing fields) to an uninterrupted run (``tests/test_resilient.py``).
+    """
     import jax
 
     from blades_tpu.audit import (
@@ -157,7 +169,12 @@ def certify_matrix(args, sweep=None) -> dict:
         staleness_row_weights,
         synthetic_honest,
     )
-    from blades_tpu.sweeps import SweepCell, run_grouped
+    from blades_tpu.sweeps import SweepCell
+    from blades_tpu.sweeps.resilient import (
+        ResilienceOptions,
+        run_cells_resilient,
+        run_grouped_resilient,
+    )
 
     k, d, trials = args.clients, args.dim, args.trials
     grids = QUICK_GRIDS if args.quick else DEFAULT_GRIDS
@@ -183,6 +200,9 @@ def certify_matrix(args, sweep=None) -> dict:
                 return nullcontext()
 
             def record(self, key_, wall_s, counter_delta=None, **kw):
+                pass
+
+            def resume(self, skipped, journal=None, quarantined=0):
                 pass
 
         sweep = _NullSweep()
@@ -246,40 +266,82 @@ def certify_matrix(args, sweep=None) -> dict:
                 ))
 
     # -- execute --------------------------------------------------------------
+    # resume: the resume record leads the attempt's trace, so every
+    # later non-``resumed`` sweep record is a genuinely executed cell —
+    # the pin the kill->relaunch e2e asserts (tests/test_resilient.py)
+    if journal is not None and journal.resumed:
+        recovered = journal.recovered([s.label for s in specs])
+        sweep.resume(
+            len(recovered),
+            journal=journal.path,
+            quarantined=sum(
+                1 for lab in recovered if journal.entry(lab) is None
+            ),
+        )
+
+    options = resilience or ResilienceOptions(
+        attempts=getattr(args, "attempts", 2) or 2,
+        cell_deadline_s=getattr(args, "cell_deadline", None),
+    )
     if sequential:
-        results, walls = [], []
-        for plan, spec in zip(plans, specs):
-            t0 = time.time()
-            with sweep.cell(spec.label):
-                if plan[0] == "async":
-                    scenario, _info = plan[5]
-                    cell = search_cell_staleness(
-                        plan[2], trials_updates, plan[4],
-                        mode="polynomial", alpha=0.5,
-                        tau_max=args.tau_max,
-                        tau_byz=0 if scenario == "fresh_byz" else args.tau_max,
-                        ctx=ctx, grids=grids, use_jit=not args.no_jit,
-                        cell_label=spec.label,
-                    )
-                else:
-                    cell = search_cell(
-                        spec.agg, spec.trials, spec.f, ctx=spec.ctx,
-                        grids=grids, use_jit=not args.no_jit,
-                        cell_label=spec.label,
-                    )
-            results.append(cell)
-            walls.append(time.time() - t0)
+        # one program per cell: each cell is already its own execution
+        # unit, so the shared per-cell resilient loop (retry -> soft
+        # deadline -> quarantine, journal recovery) applies directly —
+        # same records, same journal semantics as the batched path
+        def _run_one(idx):
+            plan, spec = plans[idx], specs[idx]
+            if plan[0] == "async":
+                scenario, _info = plan[5]
+                return search_cell_staleness(
+                    plan[2], trials_updates, plan[4],
+                    mode="polynomial", alpha=0.5,
+                    tau_max=args.tau_max,
+                    tau_byz=0 if scenario == "fresh_byz" else args.tau_max,
+                    ctx=ctx, grids=grids, use_jit=not args.no_jit,
+                    cell_label=spec.label,
+                )
+            return search_cell(
+                spec.agg, spec.trials, spec.f, ctx=spec.ctx,
+                grids=grids, use_jit=not args.no_jit,
+                cell_label=spec.label,
+            )
+
+        results, walls, report = run_cells_resilient(
+            [(spec.label, i) for i, spec in enumerate(specs)],
+            _run_one,
+            sweep=sweep, journal=journal, options=options,
+            kind="certify",
+        )
     else:
-        results, walls = run_grouped(
+        results, walls, report = run_grouped_resilient(
             specs, grids=grids, use_jit=not args.no_jit, sweep=sweep,
-            return_walls=True,
+            journal=journal, options=options,
         )
 
     # -- assemble (identical row order and content either way) ----------------
-    battery, cells, async_cells = {}, [], []
+    qinfo = {q["cell"]: q for q in report.quarantined}
+    battery, cells, async_cells, quarantined_rows = {}, [], [], []
     for plan, spec, cell, wall in zip(plans, specs, results, walls):
         kind, name, agg, f_nom, f, extra = plan
         base, _, _ = name.partition(":")
+        if cell is None:
+            # a quarantined cell renders as an attributable failure row,
+            # never a fabricated result; headline checks skip it
+            q = qinfo.get(spec.label, {})
+            row = {
+                "cell": spec.label,
+                "kind": kind,
+                "agg": name,
+                "f": f,
+                "error": q.get("error", ""),
+                "error_type": q.get("error_type", "Exception"),
+            }
+            if q.get("batch"):
+                row["batch"] = q["batch"]
+            if kind == "async":
+                row["scenario"] = extra[0]
+            quarantined_rows.append(row)
+            continue
         if kind == "battery":
             res = run_battery(
                 agg, k=k, d=d, f=max(1, f_nom), name=base, c=c,
@@ -364,8 +426,15 @@ def certify_matrix(args, sweep=None) -> dict:
         "battery": battery,
         "cells": cells,
         "async_cells": async_cells,
+        # resilient-execution accounting (blades_tpu/sweeps/resilient.py):
+        # a matrix with quarantined cells or a resumed/retried history is
+        # NOT the same evidence as a clean run and must say so
+        "quarantined_cells": quarantined_rows,
+        "resumed_skipped": report.resumed_skipped,
+        "retried": report.retried,
+        "degraded_groups": report.degraded_groups,
         "headline_failures": failures,
-        "ok": not failures,
+        "ok": not failures and not quarantined_rows,
     }
     return matrix
 
@@ -398,6 +467,15 @@ def main() -> int:
                         "fingerprint and compiles once per group — "
                         "bit-identical results, ~N_cells/N_groups fewer "
                         "compiles)")
+    p.add_argument("--attempts", type=int, default=2,
+                   help="retry budget per batched group / isolated cell "
+                        "before bisection / quarantine "
+                        "(blades_tpu/sweeps/resilient.py)")
+    p.add_argument("--cell-deadline", type=float, default=None,
+                   help="soft per-cell deadline in seconds (a group of C "
+                        "cells gets C x this); a tripped deadline "
+                        "retries, then degrades — the supervision "
+                        "heartbeat watchdog stays the hard kill layer")
     p.add_argument("--out", default=os.path.join(REPO, "results",
                                                  "certification"))
     args = p.parse_args()
@@ -410,14 +488,38 @@ def main() -> int:
     from blades_tpu.telemetry import timeline as _timeline
 
     _context.activate(fresh=True)
+    # journaled resume (blades_tpu/sweeps/journal.py): under
+    # BLADES_RESUME=1 (the supervisor's relaunch contract) completed
+    # cells are recovered from <out>/sweep_journal.jsonl and only the
+    # remainder executes; the journal is fingerprint-guarded, so a
+    # config change silently starts fresh instead of merging two
+    # different sweeps into one matrix
+    from blades_tpu.sweeps import program_fingerprint
+    from blades_tpu.sweeps.journal import SweepJournal
+
+    resume_requested = os.environ.get("BLADES_RESUME") == "1"
+    journal = SweepJournal(
+        os.path.join(args.out, "sweep_journal.jsonl"),
+        fingerprint=program_fingerprint(
+            kind="certify", clients=args.clients, dim=args.dim,
+            trials=args.trials, seed=args.seed, c=args.c,
+            quick=bool(args.quick), no_async=bool(args.no_async),
+            tau_max=args.tau_max, no_jit=bool(args.no_jit),
+            aggs=sorted(args.aggs) if args.aggs else None,
+        ),
+        resume=resume_requested,
+    )
     # sweep accounting: per-cell telemetry to <out>/sweep_trace.jsonl,
     # registered as a STARTED artifact so `runs.py --run-id` and
-    # `sweep_status.py` can watch the sweep live, not just post-mortem
+    # `sweep_status.py` can watch the sweep live, not just post-mortem.
+    # A journaled resume APPENDS — one continuous trail across attempts,
+    # the resume record marking where the new attempt takes over.
     sweep_trace = os.path.join(args.out, "sweep_trace.jsonl")
-    try:
-        os.unlink(sweep_trace)  # a fresh sweep is a new trace
-    except OSError:
-        pass
+    if not journal.resumed:
+        try:
+            os.unlink(sweep_trace)  # a fresh sweep is a new trace
+        except OSError:
+            pass
     sweep = _timeline.SweepAccounting(
         "certify", total=total_cells(args), path=sweep_trace,
         meta={"clients": args.clients, "dim": args.dim,
@@ -438,16 +540,21 @@ def main() -> int:
             "quick": bool(args.quick),
             "batched": not args.sequential,
             "aggs": sorted(args.aggs) if args.aggs else None,
+            # NOT part of the config: a resumed attempt is the SAME
+            # logical run (same config fingerprint); the resume trail
+            # lives in the sweep trace + summary, not the config
         },
-        artifacts=[os.path.relpath(sweep_trace, REPO)],
+        artifacts=[os.path.relpath(sweep_trace, REPO),
+                   os.path.relpath(journal.path, REPO)],
     )
     try:
         from blades_tpu.utils.platform import apply_env_platform
 
         apply_env_platform()
         t0 = time.time()
-        matrix = certify_matrix(args, sweep=sweep)
+        matrix = certify_matrix(args, sweep=sweep, journal=journal)
         matrix["wall_s"] = round(time.time() - t0, 1)
+        matrix["resumed"] = journal.resumed
         os.makedirs(args.out, exist_ok=True)
         artifact = os.path.join(args.out, "cert_matrix.json")
         with open(artifact, "w") as fh:
@@ -473,6 +580,14 @@ def main() -> int:
         }
         summary["sweep_cells"] = sweep.done
         summary["sweep_trace"] = os.path.relpath(sweep_trace, REPO)
+        # resilient-execution accounting: a degraded / resumed sweep must
+        # be distinguishable from a clean one at the driver line too
+        summary["resumed"] = journal.resumed
+        summary["resumed_skipped"] = matrix["resumed_skipped"]
+        summary["retried"] = matrix["retried"]
+        summary["quarantined"] = [
+            r["cell"] for r in matrix["quarantined_cells"]
+        ]
         ledger_entry.ended(
             "finished",
             metrics={
@@ -497,6 +612,7 @@ def main() -> int:
     finally:
         set_recorder(prev_recorder)
         sweep.close()
+        journal.close()
 
 
 if __name__ == "__main__":

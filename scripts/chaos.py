@@ -48,6 +48,10 @@ Usage::
     python scripts/chaos.py --sweep 24            # full sweep, one JSON line
     python scripts/chaos.py --child --seed 3 --out DIR \
         [--kill-at R | --hang-at R] [--params-out F]   # one supervised child
+    BLADES_RESUME=1 python scripts/chaos.py --sweep 24  # journaled resume:
+        # completed seeds recovered from <out>/sweep_journal.jsonl, only
+        # the remainder executes (docs/robustness.md "Resumable sweeps");
+        # a crashing seed is retried then quarantined, siblings salvaged
 
 ``tests/test_chaos.py`` runs a reduced slice tier-1 and the full sweep
 under the ``slow`` marker. Reference counterpart: none — the reference has
@@ -390,7 +394,65 @@ def max_dev_ratio(log_path: str):
 # -- sweep (the evidence artifact) --------------------------------------------
 
 
-def sweep(n: int, out_dir: str, accounting=None) -> dict:
+def _sweep_cell(scn: dict, seed: int, out_dir: str, cache) -> dict:
+    """One sweep cell's work — scenario + invariants + twin/block reruns
+    — as a retryable unit: it touches nothing outside its own log
+    directories, so the resilient retry loop in :func:`sweep` can re-run
+    it wholesale (Simulator construction re-wipes the log dir)."""
+    import numpy as np
+
+    log = os.path.join(out_dir, f"s{seed:03d}")
+    sim, params = run_scenario(scn, log, engine_cache=cache)
+    v = check_invariants(scn, log, params)
+    ev = sim.evaluate(scn["rounds"], 64)
+    if not np.isfinite(ev["Loss"]):
+        v.append("non-finite eval loss")
+    twin = inertness_variant(scn)
+    if twin is not None:
+        _, params2 = run_scenario(
+            twin, os.path.join(out_dir, f"s{seed:03d}_twin"),
+            engine_cache=cache,
+        )
+        if not np.array_equal(params, params2):
+            v.append("nan<->inf content swap changed final params")
+    # round-block slice: every 8th scenario reruns through
+    # Simulator.run(block_size=2) — the scanned round program with
+    # the sampler fused in, composed with this scenario's fault
+    # weather and the record-only audit — and must land on
+    # bit-identical params (blocks are a pure scheduling choice; 3
+    # rounds at block 2 also exercises the remainder block)
+    block_checked = seed % 8 == 2
+    if block_checked:
+        _, params_blk = run_scenario(
+            scn, os.path.join(out_dir, f"s{seed:03d}_blk"),
+            block_size=2, engine_cache=cache,
+        )
+        if not np.array_equal(params, params_blk):
+            v.append("block_size=2 changed final params")
+    return {
+        "seed": seed, "agg": scn["agg"], "attack": scn["attack"],
+        "async": scn.get("async"),
+        "fault": {
+            k: ("schedule" if k == "participation_schedule" else val)
+            for k, val in scn["fault"].items()
+        },
+        "loss": round(float(ev["Loss"]), 4),
+        "max_dev_ratio": max_dev_ratio(log),
+        "twin_checked": twin is not None,
+        "block_checked": block_checked,
+        "violations": v,
+    }
+
+
+def sweep(
+    n: int,
+    out_dir: str,
+    accounting=None,
+    journal=None,
+    attempts: int = 2,
+    base_delay_s: float = 0.5,
+    sleep=None,
+) -> dict:
     """Run scenarios 0..n-1 (+ inertness twins) in-process; returns the
     summary dict (also printed as one JSON line by ``main``).
 
@@ -399,12 +461,41 @@ def sweep(n: int, out_dir: str, accounting=None) -> dict:
     one sweep cell: per-cell wall/compile split, i-of-N, ETA in the sweep
     trace, a flush + heartbeat touch at every cell boundary (a supervised
     sweep cannot false-trip the staleness watchdog between Simulator
-    flushes). ``None`` (library callers, tests) runs unaccounted."""
-    from contextlib import nullcontext
+    flushes). ``None`` (library callers, tests) runs unaccounted.
 
-    import numpy as np
+    Fault tolerance (docs/robustness.md "Resumable sweeps"): a crashing
+    seed is retried ``attempts`` times on the shared backoff curve
+    (``utils/retry.backoff_delay``, ``retry`` records), then QUARANTINED
+    with its attributable error — the remaining seeds still run and the
+    summary reports the quarantine instead of the whole sweep dying.
+    With a ``journal`` (:class:`blades_tpu.sweeps.journal.SweepJournal`)
+    every completed seed's result row is persisted at the cell boundary
+    and recovered on a ``BLADES_RESUME=1`` relaunch, which then executes
+    only the remaining seeds. ``engine_cache`` stats reflect THIS
+    process only — a resumed sweep pays no compiles for recovered seeds,
+    so its hit/miss counts are legitimately smaller.
+    """
+    import time as _time
 
     from blades_tpu.sweeps import EngineCache
+    from blades_tpu.sweeps.resilient import (
+        ResilienceOptions,
+        run_cells_resilient,
+    )
+
+    labels = {
+        seed: f"s{seed:03d}/{make_scenario(seed)['agg']}"
+        for seed in range(n)
+    }
+    if journal is not None and journal.resumed and accounting is not None:
+        recovered = journal.recovered(list(labels.values()))
+        accounting.resume(
+            len(recovered),
+            journal=journal.path,
+            quarantined=sum(
+                1 for lab in recovered if journal.entry(lab) is None
+            ),
+        )
 
     # warm-program cache shared across the whole sweep: every scenario's
     # engine is keyed by its program fingerprint, so the inertness twin
@@ -413,57 +504,27 @@ def sweep(n: int, out_dir: str, accounting=None) -> dict:
     # The hit/miss counts land in the summary: the amortization is a
     # reported number, not an assumption.
     cache = EngineCache()
-    results, violations = [], []
-    for seed in range(n):
-        scn = make_scenario(seed)
-        log = os.path.join(out_dir, f"s{seed:03d}")
-        cell_cm = (
-            accounting.cell(f"s{seed:03d}/{scn['agg']}")
-            if accounting is not None
-            else nullcontext()
-        )
-        with cell_cm:
-            sim, params = run_scenario(scn, log, engine_cache=cache)
-            v = check_invariants(scn, log, params)
-            ev = sim.evaluate(scn["rounds"], 64)
-            if not np.isfinite(ev["Loss"]):
-                v.append("non-finite eval loss")
-            twin = inertness_variant(scn)
-            if twin is not None:
-                _, params2 = run_scenario(
-                    twin, os.path.join(out_dir, f"s{seed:03d}_twin"),
-                    engine_cache=cache,
-                )
-                if not np.array_equal(params, params2):
-                    v.append("nan<->inf content swap changed final params")
-            # round-block slice: every 8th scenario reruns through
-            # Simulator.run(block_size=2) — the scanned round program with
-            # the sampler fused in, composed with this scenario's fault
-            # weather and the record-only audit — and must land on
-            # bit-identical params (blocks are a pure scheduling choice; 3
-            # rounds at block 2 also exercises the remainder block)
-            block_checked = seed % 8 == 2
-            if block_checked:
-                _, params_blk = run_scenario(
-                    scn, os.path.join(out_dir, f"s{seed:03d}_blk"),
-                    block_size=2, engine_cache=cache,
-                )
-                if not np.array_equal(params, params_blk):
-                    v.append("block_size=2 changed final params")
-            results.append({
-                "seed": seed, "agg": scn["agg"], "attack": scn["attack"],
-                "async": scn.get("async"),
-                "fault": {
-                    k: ("schedule" if k == "participation_schedule" else val)
-                    for k, val in scn["fault"].items()
-                },
-                "loss": round(float(ev["Loss"]), 4),
-                "max_dev_ratio": max_dev_ratio(log),
-                "twin_checked": twin is not None,
-                "block_checked": block_checked,
-                "violations": v,
-            })
-            violations.extend(f"seed {seed}: {msg}" for msg in v)
+    rows, _, report = run_cells_resilient(
+        [(labels[seed], seed) for seed in range(n)],
+        lambda seed: _sweep_cell(make_scenario(seed), seed, out_dir, cache),
+        sweep=accounting,
+        journal=journal,
+        options=ResilienceOptions(
+            attempts=attempts, base_delay_s=base_delay_s,
+            sleep=sleep or _time.sleep,
+        ),
+        kind="chaos",
+    )
+    results = [r for r in rows if r is not None]
+    violations = [
+        f"seed {row['seed']}: {msg}"
+        for row in results for msg in row["violations"]
+    ]
+    quarantined = [
+        {"cell": q["cell"], "seed": int(q["cell"][1:4]),
+         "error": q["error"], "error_type": q["error_type"]}
+        for q in report.quarantined
+    ]
     return {
         "metric": "chaos_scenarios",
         "scenarios": n,
@@ -474,8 +535,13 @@ def sweep(n: int, out_dir: str, accounting=None) -> dict:
         # warm-program reuse: twin/block reruns served from the engine
         # cache (blades_tpu/sweeps) — hits are trace+compiles NOT paid
         "engine_cache": cache.stats(),
+        # resilient-execution accounting: a resumed/degraded sweep must
+        # be distinguishable from a clean one
+        "resumed_skipped": report.resumed_skipped,
+        "retried": report.retried,
+        "quarantined_cells": quarantined,
         "violations": violations,
-        "ok": not violations,
+        "ok": not violations and not quarantined,
         "results": results,
     }
 
@@ -552,44 +618,66 @@ def main() -> int:
         child_main(args)
         return 0
     n = args.sweep if args.sweep is not None else 24
+    from blades_tpu.sweeps import program_fingerprint
+    from blades_tpu.sweeps.journal import SweepJournal
     from blades_tpu.telemetry import context as _context
     from blades_tpu.telemetry import ledger as _ledger
     from blades_tpu.telemetry import timeline as _timeline
     from blades_tpu.utils.platform import apply_env_platform
 
     _context.activate(fresh=True)
+    # journaled resume (blades_tpu/sweeps/journal.py): under
+    # BLADES_RESUME=1 completed seeds are recovered from
+    # <out>/sweep_journal.jsonl and only the remainder executes; the
+    # journal is fingerprint-guarded against config drift
+    journal = SweepJournal(
+        os.path.join(args.out, "sweep_journal.jsonl"),
+        fingerprint=program_fingerprint(
+            kind="chaos", scenarios=n, clients=NUM_CLIENTS, rounds=ROUNDS,
+        ),
+        resume=os.environ.get("BLADES_RESUME") == "1",
+    )
     # sweep accounting: one cell per seed in <out>/sweep_trace.jsonl,
     # registered as a STARTED artifact so the sweep is watchable live
-    # (scripts/sweep_status.py, scripts/runs.py --run-id)
+    # (scripts/sweep_status.py, scripts/runs.py --run-id). A journaled
+    # resume APPENDS — one continuous trail across attempts.
     sweep_trace = os.path.join(args.out, "sweep_trace.jsonl")
-    try:
-        os.unlink(sweep_trace)  # a fresh sweep is a new trace
-    except OSError:
-        pass
+    if not journal.resumed:
+        try:
+            os.unlink(sweep_trace)  # a fresh sweep is a new trace
+        except OSError:
+            pass
     accounting = _timeline.SweepAccounting(
         "chaos", total=n, path=sweep_trace,
     )
     ledger_entry = _ledger.run_started(
-        "chaos", config={"kind": "chaos", "scenarios": n},
-        artifacts=[os.path.relpath(sweep_trace, REPO)],
+        "chaos",
+        # `resumed` is deliberately NOT in the config: a resumed attempt
+        # is the same logical run and must keep its config fingerprint
+        config={"kind": "chaos", "scenarios": n},
+        artifacts=[os.path.relpath(sweep_trace, REPO),
+                   os.path.relpath(journal.path, REPO)],
     )
     apply_env_platform()
     try:
-        summary = sweep(n, args.out, accounting=accounting)
+        summary = sweep(n, args.out, accounting=accounting, journal=journal)
     except Exception as e:
         ledger_entry.ended("crashed", error=f"{type(e).__name__}: {e}")
         raise
     finally:
         accounting.close()
+        journal.close()
     ledger_entry.ended(
         "finished",
         metrics={
             "scenarios": summary["scenarios"],
             "violations": len(summary["violations"]),
+            "quarantined": len(summary["quarantined_cells"]),
             "ok": summary["ok"],
         },
     )
     summary["sweep_trace"] = os.path.relpath(sweep_trace, REPO)
+    summary["resumed"] = journal.resumed
     print(json.dumps(summary))
     return 0 if summary["ok"] else 1
 

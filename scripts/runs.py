@@ -120,12 +120,18 @@ def sweep_progress(
             if p not in paths and os.path.exists(p):
                 paths.append(p)
     cells: List[Dict[str, Any]] = []
+    resilient: List[Dict[str, Any]] = []
     for p in paths:
         # read_ledger is the shared torn-line-tolerant JSONL reader — a
         # live sweep may be mid-append
-        cells.extend(
-            r for r in read_ledger(p) if r.get("t") == "sweep"
-        )
+        for r in read_ledger(p):
+            if r.get("t") == "sweep":
+                cells.append(r)
+            elif r.get("t") in ("retry", "quarantine", "resume"):
+                # resilient-execution trail (blades_tpu/sweeps/
+                # resilient.py): a resumed or degraded sweep must be
+                # distinguishable from a clean one here too
+                resilient.append(r)
     # DRIVER cells only: the SweepAccounting owner stamps the i-of-N
     # progress marker; library-level sub-cells sharing the trace (the
     # `attack_search` family certify's cells contain) carry no `i` —
@@ -169,6 +175,21 @@ def sweep_progress(
         out["last_cell_age_s"] = round(time.time() - last["ts"], 1)
     if total:
         out["frac"] = round(out["cells_completed"] / total, 4)
+    # retried / quarantined / resumed-skipped counts (sweep records carry
+    # per-cell flags too, but the dedicated records survive even when the
+    # driver died before stamping a cell)
+    retried = sum(1 for r in resilient if r.get("t") == "retry")
+    quarantined = sum(1 for r in resilient if r.get("t") == "quarantine")
+    resumes = [r for r in resilient if r.get("t") == "resume"]
+    if retried:
+        out["retried"] = retried
+    if quarantined:
+        out["quarantined"] = quarantined
+    if resumes:
+        # the LAST resume record stands: each relaunch recovers
+        # everything earlier attempts completed, and more
+        out["resumed_skipped"] = resumes[-1].get("skipped", 0)
+        out["resumes"] = len(resumes)
     eta = next(
         (c["eta_s"] for c in reversed(cells) if c.get("eta_s") is not None),
         None,

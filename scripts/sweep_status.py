@@ -50,13 +50,52 @@ def summarize_sweeps(
     meta = next((r for r in records if r.get("t") == "meta"), {})
     cells = [r for r in records if r.get("t") == "sweep"]
     families: Dict[str, Dict[str, Any]] = {}
-    for c in cells:
-        fam = families.setdefault(
-            c.get("sweep", "?"),
+
+    def _family(name: str) -> Dict[str, Any]:
+        return families.setdefault(
+            name,
             {"cells": 0, "wall_s": 0.0, "compile_s": 0.0, "execute_s": 0.0,
              "errors": 0, "total": None, "last_cell": None, "last_ts": None,
-             "eta_s": None, "batched_cells": 0, "batch_keys": set()},
+             "eta_s": None, "batched_cells": 0, "batch_keys": set(),
+             "retried": 0, "quarantined": 0, "resumed_skipped": 0,
+             "max_i": None},
         )
+
+    # resilient-execution trail (blades_tpu/sweeps/resilient.py): retry /
+    # quarantine / resume records make a degraded or resumed sweep
+    # distinguishable from a clean one at this surface
+    for r in records:
+        t = r.get("t")
+        if t == "retry" and r.get("sweep") is not None:
+            _family(r["sweep"])["retried"] += 1
+        elif t == "quarantine":
+            _family(r.get("sweep", "?"))["quarantined"] += 1
+        elif t == "resume":
+            fam = _family(r.get("sweep", "?"))
+            # the LAST resume record's count stands (each relaunch emits
+            # its own; later attempts recovered everything earlier ones
+            # did and more)
+            fam["resumed_skipped"] = r.get("skipped", 0)
+    for c in cells:
+        fam = _family(c.get("sweep", "?"))
+        if c.get("total") is not None:
+            fam["total"] = c["total"]
+        if c.get("i") is not None:
+            fam["max_i"] = max(fam["max_i"] or 0, c["i"])
+        ts = c.get("ts")
+        if ts is not None and (fam["last_ts"] is None or ts >= fam["last_ts"]):
+            fam["last_ts"] = ts
+            fam["last_cell"] = c.get("cell")
+        if c.get("eta_s") is not None:
+            fam["eta_s"] = c["eta_s"]
+        # resumed re-emits are zero-wall PROGRESS markers for cells whose
+        # real work (and errors) the interrupted attempt already
+        # recorded: they advance max_i/liveness above but must not enter
+        # the work stats — counting them would deflate mean_cell_s /
+        # per_cell_overhead_s, double-count quarantine errors, and
+        # inflate the batched-amortization ratio on every resumed trace
+        if c.get("resumed"):
+            continue
         fam["cells"] += 1
         fam["wall_s"] += c.get("wall_s", 0.0)
         fam["compile_s"] += c.get("compile_s", 0.0)
@@ -69,14 +108,6 @@ def summarize_sweeps(
             fam["batch_keys"].add(c["batch"])
         if c.get("ok") is False:
             fam["errors"] += 1
-        if c.get("total") is not None:
-            fam["total"] = c["total"]
-        ts = c.get("ts")
-        if ts is not None and (fam["last_ts"] is None or ts >= fam["last_ts"]):
-            fam["last_ts"] = ts
-            fam["last_cell"] = c.get("cell")
-        if c.get("eta_s") is not None:
-            fam["eta_s"] = c["eta_s"]
     out: Dict[str, Any] = {}
     for name, fam in families.items():
         done = fam["cells"]
@@ -105,7 +136,15 @@ def summarize_sweeps(
             )
         if fam["total"] is not None:
             row["total"] = fam["total"]
-            row["frac"] = round(done / fam["total"], 4) if fam["total"] else None
+            # progress from the max i-of-N stamp, not the record count: a
+            # resumed trace carries the interrupted attempt's records PLUS
+            # the relaunch's resumed re-emits for the same cells, and a
+            # record count would report >100% completion
+            progressed = fam["max_i"] if fam["max_i"] is not None else done
+            row["done"] = progressed
+            row["frac"] = (
+                round(progressed / fam["total"], 4) if fam["total"] else None
+            )
         if fam["last_cell"] is not None:
             row["last_cell"] = fam["last_cell"]
         if fam["last_ts"] is not None:
@@ -115,6 +154,14 @@ def summarize_sweeps(
             row["eta_s"] = fam["eta_s"]
         if fam["errors"]:
             row["errors"] = fam["errors"]
+        # resilient-execution counts (only when nonzero — a clean sweep's
+        # row stays exactly as before)
+        if fam["retried"]:
+            row["retried"] = fam["retried"]
+        if fam["quarantined"]:
+            row["quarantined"] = fam["quarantined"]
+        if fam["resumed_skipped"]:
+            row["resumed_skipped"] = fam["resumed_skipped"]
         out[name] = row
     summary: Dict[str, Any] = {"sweeps": out, "cells": len(cells)}
     if meta:

@@ -165,3 +165,63 @@ def test_committed_trajectory_artifacts_fresh():
     docs = open(os.path.join(REPO, "docs", "performance.md")).read()
     assert perf_report.DOCS_BEGIN in docs
     assert "`block_speedup` = 2.72" in docs
+
+
+def test_resume_overhead_guard(tmp_path):
+    """The resume-overhead guard (this PR): a resume that executed only
+    the remaining cells passes; one that re-executed recovered cells —
+    including the sharpest case, a fully-complete sweep resumed again —
+    is a regression."""
+    ok_trace = str(tmp_path / "ok.jsonl")
+    with open(ok_trace, "w") as f:
+        f.write(json.dumps({"t": "resume", "sweep": "certify",
+                            "skipped": 3, "total": 5}) + "\n")
+        for i in (4, 5):
+            f.write(json.dumps({"t": "sweep", "sweep": "certify",
+                                "cell": f"c{i}", "wall_s": 1.0,
+                                "i": i, "total": 5}) + "\n")
+    stats = perf_report.sweep_resume_stats([ok_trace])
+    assert stats == [{"trace": ok_trace, "sweep": "certify",
+                      "skipped": 3, "total": 5, "executed": 2}]
+    assert perf_report.check_resume_overhead(stats) == []
+
+    # resumed re-emits don't count as executed
+    reemit_trace = str(tmp_path / "reemit.jsonl")
+    with open(reemit_trace, "w") as f:
+        f.write(json.dumps({"t": "resume", "sweep": "certify",
+                            "skipped": 5, "total": 5}) + "\n")
+        for i in range(1, 6):
+            f.write(json.dumps({"t": "sweep", "sweep": "certify",
+                                "cell": f"c{i}", "wall_s": 0.0, "i": i,
+                                "total": 5, "resumed": True}) + "\n")
+    stats = perf_report.sweep_resume_stats([reemit_trace])
+    assert stats[0]["executed"] == 0
+    assert perf_report.check_resume_overhead(stats) == []
+
+    bad_trace = str(tmp_path / "bad.jsonl")
+    with open(bad_trace, "w") as f:
+        f.write(json.dumps({"t": "resume", "sweep": "certify",
+                            "skipped": 5, "total": 5}) + "\n")
+        f.write(json.dumps({"t": "sweep", "sweep": "certify", "cell": "c1",
+                            "wall_s": 1.0, "i": 6, "total": 5}) + "\n")
+    violations = perf_report.check_resume_overhead(
+        perf_report.sweep_resume_stats([bad_trace])
+    )
+    assert len(violations) == 1 and "re-executed" in violations[0]
+
+
+def test_check_gates_resume_overhead_via_cli(tmp_path):
+    """--check folds the resume guard into the regression list: a trace
+    with resume overhead fails the gate even though every baseline
+    metric is healthy."""
+    bad_trace = str(tmp_path / "sweep_trace.jsonl")
+    with open(bad_trace, "w") as f:
+        f.write(json.dumps({"t": "resume", "sweep": "certify",
+                            "skipped": 4, "total": 4}) + "\n")
+        f.write(json.dumps({"t": "sweep", "sweep": "certify", "cell": "x",
+                            "wall_s": 1.0, "i": 5, "total": 4}) + "\n")
+    proc = _run_cli(["--check", "--trace", bad_trace])
+    assert proc.returncode == 1, proc.stdout
+    payload = json.loads(proc.stdout.splitlines()[-1])
+    assert not payload["ok"]
+    assert any("resume overhead" in r for r in payload["regressions"])

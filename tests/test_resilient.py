@@ -1,0 +1,636 @@
+"""Fault-tolerant sweep execution (blades_tpu/sweeps/resilient.py +
+journal.py): poison-cell quarantine with sibling salvage, per-cell
+deadlines + bounded-backoff retry, journaled resume that executes only
+the remaining cells, and the kill-mid-sweep saboteur — the robustness
+substrate every long sweep (certify/chaos, ROADMAP item 2's sweep
+server) runs on."""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from blades_tpu.sweeps import SweepCell  # noqa: E402
+from blades_tpu.sweeps.journal import KILL_AT_ENV, SweepJournal  # noqa: E402
+from blades_tpu.sweeps.resilient import (  # noqa: E402
+    DeadlineExceeded,
+    ResilienceOptions,
+    run_grouped_resilient,
+    soft_deadline,
+)
+from blades_tpu.telemetry.schema import validate_trace  # noqa: E402
+from blades_tpu.telemetry.timeline import SweepAccounting  # noqa: E402
+
+
+class _Trials:
+    """Shape-only stand-in so grouping works without building arrays."""
+
+    ndim = 3
+    shape = (1, 4, 2)
+    dtype = "float32"
+
+
+def _cells(n):
+    return [SweepCell(f"c{i}", agg=None, trials=_Trials(), f=0)
+            for i in range(n)]
+
+
+def _opts(runner, **kw):
+    kw.setdefault("attempts", 2)
+    kw.setdefault("base_delay_s", 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    return ResilienceOptions(runner=runner, **kw)
+
+
+def _ok_result(c):
+    return {"worst_dev": 1.0, "label": c.label}
+
+
+# -- journal ------------------------------------------------------------------
+
+
+def test_journal_roundtrip_and_fingerprint_guard(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = SweepJournal(path, fingerprint="fp1")
+    j.record("a", {"x": 1.5}, wall_s=0.25)
+    j.record_quarantine("b", "ValueError: boom", "ValueError",
+                        batch="g1", attempts=2)
+    j.close()
+
+    # resume with the matching fingerprint recovers both kinds
+    r = SweepJournal(path, fingerprint="fp1", resume=True)
+    assert r.resumed
+    assert r.results() == {"a": {"x": 1.5}}
+    assert r.entry("a")["wall_s"] == 0.25
+    assert r.has("a") and r.has("b") and not r.has("c")
+    assert r.quarantined()["b"]["error_type"] == "ValueError"
+    assert r.quarantined()["b"]["batch"] == "g1"
+    r.close()
+
+    # a different config fingerprint silently starts FRESH: merging
+    # results across configurations would fabricate a matrix no single
+    # run produced
+    f = SweepJournal(path, fingerprint="fp2", resume=True)
+    assert not f.resumed
+    assert f.results() == {} and not f.has("a")
+    f.close()
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    """A SIGKILL mid-append leaves a torn last line; every completed
+    entry before it must still recover."""
+    path = str(tmp_path / "j.jsonl")
+    j = SweepJournal(path, fingerprint="fp")
+    j.record("a", {"x": 1})
+    j.record("b", {"x": 2})
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"kind": "cell", "cell": "c", "result": {"x":')  # torn
+    r = SweepJournal(path, fingerprint="fp", resume=True)
+    assert r.resumed
+    assert sorted(r.results()) == ["a", "b"]
+    r.close()
+
+
+def test_journal_saboteur_sigkills_once(tmp_path):
+    """The kill-mid-sweep test hook: BLADES_SWEEP_KILL_AT=N SIGKILLs the
+    process right after the N-th journaled cell — exactly once, gated by
+    the sentinel, so the relaunch completes (no jax; subprocess because
+    SIGKILL is SIGKILL)."""
+    path = str(tmp_path / "j.jsonl")
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from blades_tpu.sweeps.journal import SweepJournal\n"
+        "import os\n"
+        "j = SweepJournal(%r, fingerprint='fp',\n"
+        "                 resume=os.environ.get('BLADES_RESUME') == '1')\n"
+        "for i in range(3):\n"
+        "    lab = 'c%%d' %% i\n"
+        "    if not j.has(lab):\n"
+        "        j.record(lab, {'i': i})\n"
+        "print('DONE', len(j))\n"
+    ) % (REPO, path)
+    env = dict(os.environ, **{KILL_AT_ENV: "2"})
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == -signal.SIGKILL, (p.stdout, p.stderr)
+    assert os.path.exists(path + ".kill_fired")
+
+    # relaunch under resume: recovers the 2 journaled cells, the sentinel
+    # disarms the saboteur, the remaining cell lands
+    env["BLADES_RESUME"] = "1"
+    p2 = subprocess.run([sys.executable, "-c", code], env=env,
+                        capture_output=True, text=True, timeout=60)
+    assert p2.returncode == 0, (p2.stdout, p2.stderr)
+    assert "DONE 3" in p2.stdout
+
+    # a FRESH launch (no resume) clears journal + sentinel and re-arms
+    env.pop("BLADES_RESUME")
+    p3 = subprocess.run([sys.executable, "-c", code], env=env,
+                        capture_output=True, text=True, timeout=60)
+    assert p3.returncode == -signal.SIGKILL, (p3.stdout, p3.stderr)
+
+
+# -- deadlines ----------------------------------------------------------------
+
+
+def test_soft_deadline_trips_and_restores():
+    t0 = time.time()
+    with pytest.raises(DeadlineExceeded):
+        with soft_deadline(0.05):
+            time.sleep(5.0)
+    assert time.time() - t0 < 2.0
+    # timer cancelled + handler restored: nothing fires afterwards
+    with soft_deadline(None) as armed:
+        assert armed is False
+    time.sleep(0.08)
+
+
+# -- quarantine / retry / degrade ---------------------------------------------
+
+
+def test_poison_cell_quarantined_siblings_salvaged(tmp_path):
+    """The tentpole contract: one poison cell in a batched group is
+    isolated by bisection and quarantined with an attributable error
+    (type + message + group fingerprint) while every sibling's result
+    lands."""
+    cells = _cells(4)
+    calls = []
+
+    def runner(group, key):
+        labels = [c.label for c in group]
+        calls.append(labels)
+        if "c2" in labels:
+            raise ValueError("poison in " + ",".join(labels))
+        return [_ok_result(c) for c in group]
+
+    trace = str(tmp_path / "sweep_trace.jsonl")
+    sw = SweepAccounting("certify", total=4, path=trace)
+    journal = SweepJournal(str(tmp_path / "j.jsonl"), fingerprint="fp")
+    results, walls, report = run_grouped_resilient(
+        cells, sweep=sw, journal=journal, options=_opts(runner),
+    )
+    sw.close()
+    journal.close()
+
+    assert [r and r["label"] for r in results] == ["c0", "c1", None, "c3"]
+    assert report.summary()["quarantined"] == ["c2"]
+    assert report.executed == 3
+    assert report.degraded_groups >= 1
+    # the full group was retried before bisection (transient-flake budget)
+    assert report.retried >= 1
+    q = report.quarantined[0]
+    assert q["error_type"] == "ValueError"
+    assert "poison" in q["error"]
+    assert q["batch"]  # the group's program fingerprint
+
+    records = [json.loads(l) for l in open(trace) if l.strip()]
+    quar = [r for r in records if r.get("t") == "quarantine"]
+    assert len(quar) == 1 and quar[0]["cell"] == "c2"
+    assert quar[0]["error_type"] == "ValueError"
+    retries = [r for r in records if r.get("t") == "retry"]
+    assert retries and all(r["sweep"] == "certify" for r in retries)
+    # the driver trail marks the quarantined cell done-with-error, and
+    # every record the resilient layer emitted is schema-valid
+    done = [r for r in records if r.get("t") == "sweep" and r.get("i")]
+    assert len(done) == 4
+    assert [r for r in done if r.get("quarantined")][0]["cell"] == "c2"
+    assert validate_trace(trace) == []
+
+
+def test_deadline_trip_retries_then_degrades(tmp_path):
+    """A per-cell deadline trip on the batched group is retried, then
+    degrades through bisection to per-cell execution — cells salvaged,
+    the trail showing the retry."""
+    cells = _cells(4)
+    calls = []
+
+    def runner(group, key):
+        calls.append(len(group))
+        if len(group) > 1:
+            time.sleep(0.5)  # overruns len(group) * 0.02 deadline
+        return [_ok_result(c) for c in group]
+
+    trace = str(tmp_path / "sweep_trace.jsonl")
+    sw = SweepAccounting("certify", total=4, path=trace)
+    results, walls, report = run_grouped_resilient(
+        cells, sweep=sw, options=_opts(runner, cell_deadline_s=0.02),
+    )
+    sw.close()
+
+    assert all(r is not None for r in results)
+    assert report.quarantined == []
+    assert report.degraded_groups >= 1
+    assert report.retried >= 1
+    assert 1 in calls  # degraded all the way to per-cell execution
+    records = [json.loads(l) for l in open(trace) if l.strip()]
+    retries = [r for r in records if r.get("t") == "retry"]
+    assert any("DeadlineExceeded" in r.get("error", "") for r in retries)
+
+
+def test_clean_run_matches_plain_run_grouped():
+    """With nothing failing, the resilient executor runs the exact same
+    grouped programs — bit-identical results to run_grouped."""
+    import jax
+
+    from blades_tpu.aggregators import get_aggregator
+    from blades_tpu.audit import QUICK_GRIDS, battery_ctx, synthetic_honest
+    from blades_tpu.sweeps import run_grouped
+
+    trials = synthetic_honest(jax.random.PRNGKey(0), 2, 6, 8)
+    ctx = battery_ctx(None, 6, 8, key=jax.random.PRNGKey(3))
+    cells = [
+        SweepCell("m/f1", get_aggregator("median"), trials, 1, ctx),
+        SweepCell("tm/f1", get_aggregator("trimmedmean", num_byzantine=1),
+                  trials, 1, ctx),
+        SweepCell("m/f2", get_aggregator("median"), trials, 2, ctx),
+    ]
+    plain, _ = run_grouped(cells, grids=QUICK_GRIDS, use_jit=True,
+                           return_walls=True)
+    resilient, _, report = run_grouped_resilient(
+        cells, grids=QUICK_GRIDS, use_jit=True,
+    )
+    assert resilient == plain
+    assert report.retried == 0 and report.quarantined == []
+
+
+# -- resume -------------------------------------------------------------------
+
+
+def test_resume_executes_only_remaining(tmp_path):
+    """A journal holding a prefix of the cells pins the relaunch to the
+    remainder: recovered results merge idempotently, executed count is
+    exactly the missing cells."""
+    cells = _cells(4)
+    path = str(tmp_path / "j.jsonl")
+
+    j = SweepJournal(path, fingerprint="fp")
+    ran = []
+
+    def runner(group, key):
+        ran.extend(c.label for c in group)
+        return [_ok_result(c) for c in group]
+
+    full, _, _ = run_grouped_resilient(
+        cells, journal=j, options=_opts(runner),
+    )
+    j.close()
+    assert ran == ["c0", "c1", "c2", "c3"]
+
+    # keep only the first 2 journaled cells (an interrupted run)
+    lines = [l for l in open(path) if l.strip()]
+    cut = [l for l in lines
+           if json.loads(l).get("kind") != "cell"
+           or json.loads(l)["cell"] in ("c0", "c1")]
+    with open(path, "w") as f:
+        f.writelines(cut)
+
+    j2 = SweepJournal(path, fingerprint="fp", resume=True)
+    ran2 = []
+
+    def runner2(group, key):
+        ran2.extend(c.label for c in group)
+        return [_ok_result(c) for c in group]
+
+    trace = str(tmp_path / "sweep_trace.jsonl")
+    sw = SweepAccounting("certify", total=4, path=trace)
+    sw.resume(2, journal=path)
+    resumed, _, report = run_grouped_resilient(
+        cells, sweep=sw, journal=j2, options=_opts(runner2),
+    )
+    sw.close()
+    j2.close()
+
+    assert sorted(ran2) == ["c2", "c3"]  # only the remaining cells
+    assert resumed == full               # idempotent merge
+    assert report.resumed_skipped == 2 and report.executed == 2
+    records = [json.loads(l) for l in open(trace) if l.strip()]
+    assert [r["skipped"] for r in records if r.get("t") == "resume"] == [2]
+    re_emits = [r for r in records
+                if r.get("t") == "sweep" and r.get("resumed")]
+    assert {r["cell"] for r in re_emits} == {"c0", "c1"}
+    assert validate_trace(trace) == []
+
+
+def test_fully_complete_resume_executes_zero_cells(tmp_path):
+    """The resume-overhead invariant perf_report gates: resuming a
+    complete sweep executes nothing."""
+    cells = _cells(3)
+    path = str(tmp_path / "j.jsonl")
+    j = SweepJournal(path, fingerprint="fp")
+    _, _, _ = run_grouped_resilient(
+        cells, journal=j,
+        options=_opts(lambda g, k: [_ok_result(c) for c in g]),
+    )
+    j.close()
+
+    j2 = SweepJournal(path, fingerprint="fp", resume=True)
+
+    def never(group, key):
+        raise AssertionError("a complete sweep must not execute cells")
+
+    results, _, report = run_grouped_resilient(
+        cells, journal=j2, options=_opts(never),
+    )
+    j2.close()
+    assert all(r is not None for r in results)
+    assert report.executed == 0 and report.resumed_skipped == 3
+
+
+def test_certify_matrix_resume_merges_identical(tmp_path):
+    """Driver-level resume: an interrupted certify journal (prefix of the
+    cells) resumes into a matrix content-identical (timing stripped) to
+    the uninterrupted run's."""
+    import certify
+
+    def mkargs():
+        return argparse.Namespace(
+            clients=4, dim=4, trials=1, seed=0, c=None,
+            aggs=["mean", "median"], quick=True, no_async=True,
+            tau_max=2, no_jit=False, sequential=False,
+            out=str(tmp_path),
+        )
+
+    path = str(tmp_path / "j.jsonl")
+    j = SweepJournal(path, fingerprint="fp")
+    ref = certify.certify_matrix(mkargs(), journal=j)
+    j.close()
+    assert ref["ok"] and ref["resumed_skipped"] == 0
+
+    # drop the journal's tail: the last 2 cells become "not yet run"
+    lines = [l for l in open(path) if l.strip()]
+    cell_lines = [l for l in lines if json.loads(l).get("kind") == "cell"]
+    drop = {json.loads(l)["cell"] for l in cell_lines[-2:]}
+    with open(path, "w") as f:
+        f.writelines(
+            l for l in lines
+            if json.loads(l).get("kind") != "cell"
+            or json.loads(l)["cell"] not in drop
+        )
+
+    j2 = SweepJournal(path, fingerprint="fp", resume=True)
+    res = certify.certify_matrix(mkargs(), journal=j2)
+    j2.close()
+    assert res["resumed_skipped"] == len(cell_lines) - 2
+
+    def strip(m):
+        m = json.loads(json.dumps(m))
+        for k in ("resumed_skipped", "retried", "degraded_groups"):
+            m.pop(k)
+        for row in m["cells"] + m["async_cells"]:
+            row.pop("search_s")
+        return m
+
+    assert strip(ref) == strip(res)
+
+
+def test_certify_sequential_quarantine_records(tmp_path, monkeypatch):
+    """The sequential (--sequential) certify path routes through the same
+    per-cell resilient loop: a poison cell is retried, quarantined with
+    the full record trail (quarantine event + flagged sweep record +
+    journal entry), and every other cell's result lands."""
+    import blades_tpu.audit as audit_mod
+    import certify
+
+    real = audit_mod.search_cell
+
+    def poison(agg, trials, f, **kw):
+        if kw.get("cell_label") == "median/f1":
+            raise ValueError("sequential poison")
+        return real(agg, trials, f, **kw)
+
+    monkeypatch.setattr(audit_mod, "search_cell", poison)
+
+    args = argparse.Namespace(
+        clients=4, dim=4, trials=1, seed=0, c=None,
+        aggs=["mean", "median"], quick=True, no_async=True,
+        tau_max=2, no_jit=False, sequential=True, out=str(tmp_path),
+        attempts=2,
+    )
+    trace = str(tmp_path / "sweep_trace.jsonl")
+    sw = SweepAccounting("certify", total=6, path=trace)
+    journal = SweepJournal(str(tmp_path / "j.jsonl"), fingerprint="fp")
+    from blades_tpu.sweeps.resilient import ResilienceOptions
+
+    m = certify.certify_matrix(
+        args, sweep=sw, journal=journal,
+        resilience=ResilienceOptions(attempts=2, base_delay_s=0.0,
+                                     sleep=lambda s: None),
+    )
+    sw.close()
+    journal.close()
+
+    assert m["ok"] is False
+    assert [q["cell"] for q in m["quarantined_cells"]] == ["median/f1"]
+    assert m["quarantined_cells"][0]["error_type"] == "ValueError"
+    assert len(m["cells"]) == 3  # mean/f0 mean/f1 median/f0 survived
+    assert journal.has("median/f1")  # a resume will not replay the poison
+
+    records = [json.loads(l) for l in open(trace) if l.strip()]
+    quar = [r for r in records if r.get("t") == "quarantine"]
+    assert len(quar) == 1 and quar[0]["cell"] == "median/f1"
+    assert [r for r in records if r.get("t") == "retry"]
+    flagged = [r for r in records
+               if r.get("t") == "sweep" and r.get("quarantined")]
+    assert len(flagged) == 1 and flagged[0]["cell"] == "median/f1"
+    assert validate_trace(trace) == []
+
+
+# -- status surfaces ----------------------------------------------------------
+
+
+def test_sweep_status_reports_resilience_counts():
+    from sweep_status import summarize_sweeps
+
+    records = [
+        {"t": "sweep", "sweep": "certify", "cell": "a", "wall_s": 1.0,
+         "ts": 100.0, "i": 1, "total": 3},
+        {"t": "resume", "sweep": "certify", "skipped": 1, "total": 3},
+        {"t": "sweep", "sweep": "certify", "cell": "a", "wall_s": 0.0,
+         "ts": 101.0, "i": 1, "total": 3, "resumed": True},
+        {"t": "retry", "what": "sweep_group", "attempt": 1, "delay_s": 0.5,
+         "sweep": "certify", "batch": "g"},
+        {"t": "sweep", "sweep": "certify", "cell": "b", "wall_s": 1.0,
+         "ts": 102.0, "i": 2, "total": 3, "retries": 1},
+        {"t": "quarantine", "sweep": "certify", "cell": "c",
+         "error": "ValueError: boom", "error_type": "ValueError"},
+        {"t": "sweep", "sweep": "certify", "cell": "c", "wall_s": 0.0,
+         "ts": 103.0, "i": 3, "total": 3, "ok": False,
+         "error": "ValueError: boom", "error_type": "ValueError",
+         "quarantined": True},
+    ]
+    fam = summarize_sweeps(records)["sweeps"]["certify"]
+    assert fam["retried"] == 1
+    assert fam["quarantined"] == 1
+    assert fam["resumed_skipped"] == 1
+    assert fam["errors"] == 1
+    # progress dedupes the resumed re-emit: 3 of 3, not 4 of 3
+    assert fam["done"] == 3 and fam["frac"] == 1.0
+
+
+def test_runs_sweep_progress_reports_resilience(tmp_path):
+    from runs import sweep_progress
+
+    trace = str(tmp_path / "sweep_trace.jsonl")
+    now = time.time()
+    records = [
+        {"t": "resume", "sweep": "certify", "skipped": 2, "total": 4},
+        {"t": "sweep", "sweep": "certify", "cell": "a", "wall_s": 0.0,
+         "ts": now, "i": 1, "total": 4, "resumed": True},
+        {"t": "sweep", "sweep": "certify", "cell": "b", "wall_s": 0.0,
+         "ts": now, "i": 2, "total": 4, "resumed": True},
+        {"t": "retry", "what": "sweep_cell", "attempt": 1, "delay_s": 0.5,
+         "sweep": "certify", "cell": "c"},
+        {"t": "sweep", "sweep": "certify", "cell": "c", "wall_s": 1.0,
+         "ts": now, "i": 3, "total": 4, "retries": 1},
+        {"t": "quarantine", "sweep": "certify", "cell": "d",
+         "error": "TypeError: nope", "error_type": "TypeError"},
+        {"t": "sweep", "sweep": "certify", "cell": "d", "wall_s": 0.0,
+         "ts": now, "i": 4, "total": 4, "ok": False,
+         "error": "TypeError: nope", "error_type": "TypeError",
+         "quarantined": True},
+    ]
+    with open(trace, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    out = sweep_progress([{"artifacts": [trace]}], repo=str(tmp_path))
+    assert out["cells_completed"] == 4
+    assert out["retried"] == 1
+    assert out["quarantined"] == 1
+    assert out["resumed_skipped"] == 2
+    assert out["resumes"] == 1
+
+
+# -- kill-mid-sweep, tier-1 reduced form --------------------------------------
+
+
+CHAOS = os.path.join(REPO, "scripts", "chaos.py")
+
+
+def test_chaos_kill_mid_sweep_resume_tier1(tmp_path):
+    """The chaos sweep's saboteur path, tier-1 reduced: SIGKILL after the
+    first journaled seed, relaunch under BLADES_RESUME=1 recovers that
+    seed's result and executes only the remaining one — the sweep
+    completes with zero violations and a complete result set. (The
+    resumed-equals-uninterrupted content identity is pinned at the
+    certify driver by test_certify_matrix_resume_merges_identical and
+    the slow supervised e2e; this test spends its two subprocesses on
+    the SIGKILL itself.)"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("BLADES_RESUME", None)
+    env.pop(KILL_AT_ENV, None)
+
+    out = tmp_path / "sup"
+    killed = subprocess.run(
+        [sys.executable, CHAOS, "--sweep", "2", "--out", str(out)],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(env, **{KILL_AT_ENV: "1"}), timeout=420,
+    )
+    assert killed.returncode == -signal.SIGKILL, (
+        killed.stdout, killed.stderr,
+    )
+    journal = [json.loads(l)
+               for l in open(out / "sweep_journal.jsonl") if l.strip()]
+    assert sum(r.get("kind") == "cell" for r in journal) == 1
+
+    resumed = subprocess.run(
+        [sys.executable, CHAOS, "--sweep", "2", "--out", str(out)],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(env, BLADES_RESUME="1"), timeout=420,
+    )
+    assert resumed.returncode == 0, (resumed.stdout, resumed.stderr)
+    res = json.loads(resumed.stdout.splitlines()[-1])
+    assert res["ok"] is True
+    assert res["resumed"] is True
+    assert res["resumed_skipped"] == 1
+    assert res["scenarios"] == 2 and len(res["results"]) == 2
+    assert res["violations"] == [] and res["quarantined_cells"] == []
+    # seed 0's row came from the journal, seed 1's from execution — the
+    # merged result set is seed-ordered and complete
+    assert [r["seed"] for r in res["results"]] == [0, 1]
+
+    # the trace pins it: one resume record, and exactly one executed
+    # (non-resumed) driver cell after it
+    trace = out / "sweep_trace.jsonl"
+    records = [json.loads(l) for l in open(trace) if l.strip()]
+    r_idx = max(i for i, r in enumerate(records) if r.get("t") == "resume")
+    executed = [r for r in records[r_idx:]
+                if r.get("t") == "sweep" and r.get("i")
+                and not r.get("resumed")]
+    assert len(executed) == 1
+    assert validate_trace(str(trace)) == []
+
+
+# -- the slow e2e: supervised certify SIGKILL ---------------------------------
+
+
+@pytest.mark.slow
+def test_certify_sigkill_resume_supervised_e2e(tmp_path):
+    """The acceptance e2e: certify.py SIGKILLed mid-sweep under the
+    supervisor resumes with BLADES_RESUME=1 (the supervisor's relaunch
+    contract), executes only the remaining cells, and produces a
+    cert_matrix.json content-identical (timing fields aside) to an
+    uninterrupted run."""
+    from blades_tpu.supervision import Supervisor
+
+    CERTIFY = os.path.join(REPO, "scripts", "certify.py")
+    argv = ["--clients", "6", "--dim", "8", "--trials", "2", "--quick",
+            "--no-async", "--aggs", "mean", "median", "krum"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("BLADES_RESUME", None)
+    env.pop(KILL_AT_ENV, None)
+
+    ref_out = tmp_path / "ref"
+    p = subprocess.run(
+        [sys.executable, CERTIFY, *argv, "--out", str(ref_out)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
+    )
+    assert p.returncode == 0, (p.stdout, p.stderr)
+    ref = json.load(open(ref_out / "cert_matrix.json"))
+
+    sup_out = tmp_path / "sup"
+    telem = str(tmp_path / "sup_telemetry.jsonl")
+    result = Supervisor(
+        [sys.executable, CERTIFY, *argv, "--out", str(sup_out)],
+        attempts=2, base_delay_s=0.1, poll_s=0.2, telemetry_path=telem,
+        heartbeat_file=str(tmp_path / "hb"),
+        env={"JAX_PLATFORMS": "cpu", KILL_AT_ENV: "4"},
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    ).run()
+    assert result.ok
+    assert result.attempts[0].returncode == -signal.SIGKILL
+    assert result.attempts[1].resumed
+    res = json.load(open(sup_out / "cert_matrix.json"))
+    assert res["resumed"] is True
+    assert res["resumed_skipped"] >= 4
+
+    def strip(m):
+        m = json.loads(json.dumps(m))
+        for k in ("wall_s", "resumed", "resumed_skipped", "retried",
+                  "degraded_groups"):
+            m.pop(k, None)
+        for row in m["cells"] + m["async_cells"]:
+            row.pop("search_s")
+        return m
+
+    assert strip(ref) == strip(res)
+
+    # pinned via sweep records: the resumed attempt executed only the
+    # remaining cells
+    records = [json.loads(l)
+               for l in open(sup_out / "sweep_trace.jsonl") if l.strip()]
+    r_idx = max(i for i, r in enumerate(records) if r.get("t") == "resume")
+    skipped = records[r_idx]["skipped"]
+    total = records[r_idx]["total"]
+    executed = [r for r in records[r_idx:]
+                if r.get("t") == "sweep" and r.get("i")
+                and not r.get("resumed")]
+    assert len(executed) == total - skipped
