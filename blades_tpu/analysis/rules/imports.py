@@ -45,6 +45,15 @@ NO_JAX_SUFFIXES = (
     "blades_tpu/supervision/supervisor.py",
     "blades_tpu/analysis/__init__.py",
     "blades_tpu/analysis/core.py",
+    # the simulation service (PR 14): clients submit from hosts where the
+    # tunnel is down, and a probe-only server must start (and drill the
+    # chaos scenarios) in interpreter-import time — the jax-touching
+    # simulate handler stays behind function-scope imports
+    "blades_tpu/service/__init__.py",
+    "blades_tpu/service/protocol.py",
+    "blades_tpu/service/client.py",
+    "blades_tpu/service/spool.py",
+    "blades_tpu/service/server.py",
 )
 
 #: blades modules known to import jax at module scope — importing one of

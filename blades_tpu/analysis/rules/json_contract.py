@@ -32,6 +32,7 @@ CONTRACT_SCRIPTS = (
     "scripts/certify.py",
     "scripts/perf_report.py",
     "scripts/runs.py",
+    "scripts/serve.py",
     "scripts/sweep_status.py",
     "blades_tpu/analysis/__main__.py",
 )

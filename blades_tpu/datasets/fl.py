@@ -122,7 +122,17 @@ class FLDataset:
         ``device_put`` requires even divisibility, and the engine's
         in-graph ``with_sharding_constraint`` path handles the uneven case
         with implicit padding, so the default layout stays correct.
+
+        Also a no-op when the dataset is ALREADY placed in this exact
+        layout: warm-process serving (``blades_tpu/service``) and the
+        sweep drivers construct one Simulator per scenario over shared
+        datasets, and re-placing identically would re-``device_put`` the
+        store and wipe the warm sampler jits — one spurious re-trace +
+        compile-counter tick per request (caught by the service's
+        zero-new-compiles gate).
         """
+        if self._sharding is not None and clients_sharding == self._sharding:
+            return self
         try:
             tx = jax.device_put(self.train_x, clients_sharding)
             ty = jax.device_put(self.train_y, clients_sharding)

@@ -1,0 +1,73 @@
+"""Simulation service: a long-lived, crash-tolerant experiment server.
+
+ROADMAP item 2's remaining gap was the server PROCESS: PR 12 made sweeps
+run from warm fingerprint-grouped programs and PR 13 built the per-cell
+journal/retry/quarantine substrate — but every experiment still paid a
+cold process start (on this box: tens of seconds of imports before the
+first trace, minutes of trace/lowering the persistent XLA cache cannot
+absorb), and nothing supervised a queue of heterogeneous requests. This
+package is that server: one warm process owning an
+:class:`~blades_tpu.sweeps.EngineCache`, serving simulation requests
+submitted over a unix-domain socket, with request-level fault isolation
+reusing the PR 13 resilient ladder rather than re-inventing it.
+
+The robustness contract (docs/robustness.md "Simulation service"):
+
+- every request runs through :func:`~blades_tpu.sweeps.resilient
+  .run_cells_resilient` — per-cell soft deadline, bounded-backoff retry,
+  poison-cell quarantine — so one bad request never takes down the
+  process or its neighbors;
+- admission control bounds queue depth with an explicit
+  ``rejected: backpressure`` reply instead of unbounded memory growth on
+  the 1-core box;
+- every admitted request is journaled to a crash-safe on-disk **spool**
+  (:class:`~blades_tpu.service.spool.RequestSpool`) before it is queued,
+  and its per-cell results to a :class:`~blades_tpu.sweeps.journal
+  .SweepJournal` — SIGKILL is survivable: a relaunch under
+  ``BLADES_RESUME=1`` (what ``python -m blades_tpu.supervision`` exports)
+  replays the spool, executes only unjournaled cells, and the
+  client-visible result is content-identical to an uninterrupted run;
+- SIGTERM triggers graceful **drain**: finish in-flight and queued
+  requests, journal, reply, exit 0;
+- the server beats ``BLADES_HEARTBEAT_FILE`` per request-cell (and on an
+  idle tick), so it runs under the supervision watchdog like any other
+  workload.
+
+Import discipline: this ``__init__``, :mod:`~blades_tpu.service
+.protocol`, :mod:`~blades_tpu.service.client`, :mod:`~blades_tpu.service
+.spool`, and :mod:`~blades_tpu.service.server` are stdlib-only and
+importable before jax (IMP001-contracted, like ``telemetry/context.py``)
+— a client submitting requests from a host where the tunnel is down, or
+a probe-only server, never pays the jax import. The jax-touching request
+execution (:mod:`~blades_tpu.service.handlers`' ``simulate`` runner, the
+resilient executor's retry-curve import) stays behind function-scope
+imports on the server's execution path.
+
+CLI: ``python scripts/serve.py start|submit|status|result|drain`` (one
+JSON line each). Reference counterpart: none — the reference runs one
+configuration per cold process and has no serving layer at all
+(``src/blades/simulator.py``); the request-loop shape follows production
+FL servers (Bonawitz et al., 2019, selection/aggregation as a long-lived
+service).
+"""
+
+from __future__ import annotations
+
+from blades_tpu.service.client import ServiceClient, ServiceError  # noqa: F401
+from blades_tpu.service.protocol import (  # noqa: F401
+    DEFAULT_SOCKET_NAME,
+    mint_request_id,
+    read_message,
+    write_message,
+)
+from blades_tpu.service.spool import RequestSpool  # noqa: F401
+
+__all__ = [
+    "DEFAULT_SOCKET_NAME",
+    "RequestSpool",
+    "ServiceClient",
+    "ServiceError",
+    "mint_request_id",
+    "read_message",
+    "write_message",
+]

@@ -1,0 +1,250 @@
+"""Request handlers: what a service request's cells actually execute.
+
+A request is ``{"kind": ..., "cells": [...]}``; a handler turns it into
+the ``(label, payload)`` cell list + ``run_cell`` callable the resilient
+executor consumes (:func:`blades_tpu.sweeps.resilient
+.run_cells_resilient`). Two built-in kinds:
+
+- ``probe`` — stdlib-only cells for health checks and chaos drills: each
+  cell is ``{"label", "op": "ok" | "fail" | "sleep", ...}``. ``ok``
+  echoes a deterministic result, ``fail`` raises (the poison-request
+  drill), ``sleep`` blocks for ``sleep_s`` (the hung-request drill — it
+  trips the per-cell deadline). Probe requests never import jax, so a
+  probe-only server starts in interpreter-import time and the chaos
+  service scenarios (``scripts/chaos.py --service``) run in seconds.
+- ``simulate`` — each cell is a chaos-style scenario dict (``agg``,
+  ``attack``/``num_byz``, ``fault``, ``rounds``, ``seed``, sizes) run as
+  a full :class:`~blades_tpu.Simulator` round sequence on the seeded
+  :class:`~blades_tpu.datasets.Synthetic` dataset, through the server's
+  shared :class:`~blades_tpu.sweeps.EngineCache` — a cell whose static
+  config matches any earlier cell (this request or a previous one)
+  reuses the warm compiled round/eval programs, which is the whole point
+  of serving from one long-lived process. Results are deterministic
+  functions of the scenario (loss + a params content hash), so a
+  journaled resume is content-identical by construction.
+
+Cell payloads must stay JSON-round-trippable: the spool and the cell
+journal both persist them, and a resumed request re-executes from the
+spooled copy, not the in-memory one.
+
+Reference counterpart: the ``simulate`` scenario shape mirrors the
+reference's per-process run configuration (``src/blades/simulator.py``
+constructor + ``run``), served here as one cell of a warm process.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import time
+from typing import Any, Callable, Dict, List, Tuple
+
+__all__ = ["build_cells", "make_runner", "safe_name", "REQUEST_KINDS"]
+
+REQUEST_KINDS = ("probe", "simulate")
+
+#: Request ids and cell labels become FILESYSTEM path segments (the
+#: per-request journal dir, each simulate cell's log dir) — and the
+#: Simulator WIPES its log dir at construction, so a label like
+#: ``/root/repo/results`` or ``../..`` would make the server destroy an
+#: arbitrary directory (``os.path.join`` discards everything before an
+#: absolute segment). One safe charset, enforced at admission and at
+#: cell build.
+_SAFE_NAME = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,119}$")
+
+
+def safe_name(value: Any, what: str) -> str:
+    """``value`` as a validated path-safe name, or ``ValueError``."""
+    name = str(value)
+    if not _SAFE_NAME.match(name):
+        raise ValueError(
+            f"{what} {name!r} is not a safe name (need "
+            "[A-Za-z0-9][A-Za-z0-9._-]*, max 120 chars — it becomes a "
+            "filesystem path segment)"
+        )
+    return name
+
+#: Env var carrying the virtual-CPU device count the lazily-initialized
+#: jax backend should present (set by ``scripts/serve.py start
+#: --devices``; the first simulate cell applies it).
+DEVICES_ENV = "BLADES_SERVICE_DEVICES"
+
+_SIM_DEFAULTS = {
+    "clients": 8,
+    "rounds": 2,
+    "local_steps": 1,
+    "train_batch_size": 8,
+    "train_size": 256,
+    "test_size": 64,
+    "client_lr": 0.2,
+    "seed": 0,
+}
+
+
+def build_cells(request: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
+    """Validate a request and return its ``(label, payload)`` cells.
+
+    Raises ``ValueError`` on a malformed request — the server converts
+    that into an ``error`` reply (the request never enters execution, so
+    it costs no retry budget)."""
+    kind = request.get("kind")
+    if kind not in REQUEST_KINDS:
+        raise ValueError(
+            f"unknown request kind {kind!r} (supported: {REQUEST_KINDS})"
+        )
+    raw = request.get("cells")
+    if not isinstance(raw, list) or not raw:
+        raise ValueError("request has no cells (expected a non-empty list)")
+    cells: List[Tuple[str, Dict[str, Any]]] = []
+    seen = set()
+    for i, payload in enumerate(raw):
+        if not isinstance(payload, dict):
+            raise ValueError(f"cell {i} is not an object")
+        label = safe_name(payload.get("label") or f"c{i:03d}", "cell label")
+        if label in seen:
+            raise ValueError(f"duplicate cell label {label!r}")
+        seen.add(label)
+        # the runner sees the payload, not the (label, payload) pair —
+        # inject the DERIVED label so an absent/empty one cannot make
+        # simulate cells share (and wipe) each other's log dirs, or
+        # resolve an empty segment to the request dir itself
+        cells.append((label, {**payload, "label": label}))
+    return cells
+
+
+def make_runner(
+    request: Dict[str, Any], ctx: Dict[str, Any]
+) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+    """The ``run_cell`` callable for one request. ``ctx`` carries the
+    server's shared state: ``cache`` (the warm EngineCache), ``out_dir``,
+    ``request_id``."""
+    if request.get("kind") == "probe":
+        return _run_probe
+    return lambda payload: _run_simulate(payload, ctx)
+
+
+# -- probe ---------------------------------------------------------------------
+
+
+def _run_probe(payload: Dict[str, Any]) -> Dict[str, Any]:
+    op = payload.get("op", "ok")
+    if op == "fail":
+        raise RuntimeError(
+            str(payload.get("message") or "probe cell requested failure")
+        )
+    if op == "sleep":
+        # the hung-request drill: blocks until the per-cell soft deadline
+        # (SIGALRM interrupts the sleep) or completion
+        time.sleep(float(payload.get("sleep_s", 1.0)))
+    elif op != "ok":
+        raise ValueError(f"unknown probe op {op!r}")
+    return {
+        "label": str(payload["label"]),
+        "op": op,
+        "value": payload.get("value"),
+    }
+
+
+# -- simulate ------------------------------------------------------------------
+
+_platform_forced = False
+
+
+def _force_platform_once() -> None:
+    """Apply the virtual-CPU device count before the first jax touch.
+
+    The env var alone is NOT enough on this box (the axon sitecustomize
+    re-forces its platform — CLAUDE.md), so route through
+    ``utils.platform.force_virtual_cpu`` exactly once, lazily: probe-only
+    servers never reach this."""
+    global _platform_forced
+    if _platform_forced:
+        return
+    _platform_forced = True
+    devices = os.environ.get(DEVICES_ENV)
+    if devices:
+        from blades_tpu.utils.platform import force_virtual_cpu
+
+        force_virtual_cpu(int(devices))
+
+
+def _dataset_for(scn: Dict[str, Any], ctx: Dict[str, Any]):
+    """The (warm) seeded Synthetic dataset for one scenario.
+
+    Cached per config in the server's ``datasets`` dict, next to the
+    engine cache: the dataset owns its own per-instance jitted sampler
+    (``datasets/fl.py:sample_round``), so a fresh instance per request
+    would re-trace it every time — one compile-counter tick per request
+    that the warm-serving gate (``perf_report.py --check``) would
+    rightly flag. Sampling is keyed off the Simulator seed, never
+    dataset state, so reuse cannot change results."""
+    from blades_tpu.datasets import Synthetic
+
+    key = (
+        int(scn["clients"]), int(scn["train_size"]),
+        int(scn["test_size"]), float(scn.get("noise", 0.3)),
+    )
+    cache = ctx.setdefault("datasets", {})
+    ds = cache.get(key)
+    if ds is None:
+        ds = Synthetic(
+            num_clients=key[0], train_size=key[1], test_size=key[2],
+            noise=key[3], cache=False,
+        )
+        cache[key] = ds
+    return ds
+
+
+def _run_simulate(
+    payload: Dict[str, Any], ctx: Dict[str, Any]
+) -> Dict[str, Any]:
+    """One scenario cell: build (or cache-hit) the engine, run the
+    rounds, return a deterministic result row."""
+    _force_platform_once()
+
+    import numpy as np
+
+    from blades_tpu import Simulator
+    from blades_tpu.ops.pytree import ravel
+
+    scn = {**_SIM_DEFAULTS, **payload}
+    # build_cells injected the derived, validated label — never absent,
+    # never empty, unique within the request
+    log = os.path.join(
+        ctx["out_dir"], "requests", str(ctx["request_id"]),
+        str(payload["label"]),
+    )
+    sim = Simulator(
+        dataset=_dataset_for(scn, ctx),
+        aggregator=scn.get("agg", "mean"),
+        aggregator_kws=dict(scn.get("agg_kws") or {}),
+        attack=scn.get("attack"),
+        num_byzantine=int(scn.get("num_byz", 0)),
+        log_path=log,
+        seed=int(scn["seed"]),
+    )
+    sim.run(
+        scn.get("model", "mlp"),
+        engine_cache=ctx.get("cache"),
+        global_rounds=int(scn["rounds"]),
+        local_steps=int(scn["local_steps"]),
+        train_batch_size=int(scn["train_batch_size"]),
+        client_lr=float(scn["client_lr"]),
+        server_lr=float(scn.get("server_lr", 1.0)),
+        validate_interval=int(scn["rounds"]),
+        fault_model=(
+            dict(scn["fault"]) if scn.get("fault") else None
+        ),
+    )
+    params = np.asarray(ravel(sim.server.state.params))
+    ev = sim.evaluate(int(scn["rounds"]), 64)
+    return {
+        "label": str(payload["label"]),
+        "agg": scn.get("agg", "mean"),
+        "loss": round(float(ev["Loss"]), 6),
+        "finite": bool(np.isfinite(params).all()),
+        # content hash, not the vector: replies stay small and a resumed
+        # request's content-identity is still byte-checkable
+        "params_sha": hashlib.sha256(params.tobytes()).hexdigest()[:16],
+    }

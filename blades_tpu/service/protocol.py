@@ -1,0 +1,119 @@
+"""Service wire protocol: newline-delimited JSON over a unix socket.
+
+One message per line, UTF-8 JSON, ``\\n``-terminated — the same
+torn-line-tolerant JSONL dialect every other surface of this repo speaks
+(telemetry traces, the journal, the spool), chosen over a binary framing
+so a wedged server can be interrogated with ``nc -U`` and a spool replay
+can reuse the exact client payloads. Messages are size-capped
+(:data:`MAX_MESSAGE_BYTES`) so a malformed client cannot balloon the
+1-core server's memory before admission control even sees the request.
+
+Client -> server messages carry an ``op``:
+
+- ``{"op": "submit", "request": {...}, "wait": true}`` — admit and (by
+  default) block until the request completes; ``wait: false`` returns
+  ``{"status": "accepted"}`` immediately and the client later fetches
+  via ``result``.
+- ``{"op": "result", "id": ...}`` — fetch a completed reply from the
+  spool (``status``: ``done`` / ``pending`` / ``unknown``). This is the
+  crash-recovery path: a client whose ``submit`` connection died with a
+  SIGKILLed server polls ``result`` against the relaunched one.
+- ``{"op": "status"}`` — health snapshot (queue depth, in-flight,
+  served/rejected/quarantined counts, oldest-pending age).
+- ``{"op": "drain"}`` — graceful shutdown: finish everything admitted,
+  reply to waiting clients, exit 0 (the in-band form of SIGTERM).
+- ``{"op": "ping"}`` — liveness.
+
+A request body is ``{"id": optional, "kind": "probe" | "simulate",
+"cells": [...]}`` — per-cell payloads are handler-specific
+(:mod:`blades_tpu.service.handlers`). Client-supplied ids make
+resubmission idempotent: a ``submit`` whose id the spool already holds a
+reply for is served from the spool, never re-executed.
+
+Stdlib-only, importable before jax (IMP001). Reference counterpart: none
+— the reference has no serving surface (``src/blades/simulator.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "DEFAULT_SOCKET_NAME",
+    "MAX_MESSAGE_BYTES",
+    "ProtocolError",
+    "mint_request_id",
+    "read_message",
+    "write_message",
+]
+
+#: Default socket filename inside the service's --out directory.
+DEFAULT_SOCKET_NAME = "service.sock"
+
+#: Hard cap on one encoded message (request payloads are config dicts and
+#: result rows, never tensors — 8 MiB is orders of magnitude of headroom).
+MAX_MESSAGE_BYTES = 8 * 1024 * 1024
+
+
+class ProtocolError(Exception):
+    """A malformed or oversized wire message."""
+
+
+def mint_request_id() -> str:
+    """A fresh, human-sortable request id (same dialect as run ids)."""
+    return (
+        "req-"
+        + time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        + "-"
+        + uuid.uuid4().hex[:8]
+    )
+
+
+def write_message(wfile, obj: Dict[str, Any]) -> None:
+    """Encode ``obj`` as one JSON line onto a writable binary file."""
+    data = (json.dumps(obj) + "\n").encode()
+    if len(data) > MAX_MESSAGE_BYTES:
+        raise ProtocolError(
+            f"message of {len(data)} bytes exceeds the "
+            f"{MAX_MESSAGE_BYTES}-byte cap"
+        )
+    wfile.write(data)
+    wfile.flush()
+
+
+def read_message(rfile) -> Optional[Dict[str, Any]]:
+    """Read one JSON-line message from a readable binary file.
+
+    Returns ``None`` on a cleanly closed peer (EOF before any bytes);
+    raises :class:`ProtocolError` on an oversized or unparseable line —
+    the server converts that into one error reply, never a crash.
+    """
+    line = rfile.readline(MAX_MESSAGE_BYTES + 1)
+    if not line:
+        return None
+    if len(line) > MAX_MESSAGE_BYTES:
+        raise ProtocolError(
+            f"message exceeds the {MAX_MESSAGE_BYTES}-byte cap"
+        )
+    try:
+        obj = json.loads(line.decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ProtocolError(f"unparseable message: {e}") from None
+    if not isinstance(obj, dict):
+        raise ProtocolError(
+            f"message must be a JSON object, got {type(obj).__name__}"
+        )
+    return obj
+
+
+def socket_path_for(out_dir: str, socket_path: Optional[str] = None) -> str:
+    """The service's socket path (default: ``<out>/service.sock``).
+
+    Unix socket paths are length-capped (~108 bytes incl. NUL); a too-deep
+    ``out_dir`` fails at bind with a clear error rather than here.
+    """
+    return socket_path or os.path.join(out_dir, DEFAULT_SOCKET_NAME)

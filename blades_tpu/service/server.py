@@ -1,0 +1,756 @@
+"""The simulation service process: warm-cache request loop over a unix
+socket, with request-level fault isolation and crash-safe resume.
+
+One process, two threads, one discipline:
+
+- the **listener** thread accepts connections, answers the cheap ops
+  (``ping``/``status``/``result``/``drain``) inline, and runs admission
+  control for ``submit``: a durable spool append
+  (:class:`~blades_tpu.service.spool.RequestSpool`) THEN the in-memory
+  queue — bounded at ``max_queue`` with an explicit ``rejected:
+  backpressure`` reply (this box has one core and finite memory; an
+  unbounded queue is just a slower crash);
+- the **worker** (the thread that called :meth:`SimulationService.serve`
+  — the main thread, because the per-cell soft deadline is SIGALRM-based)
+  executes requests one at a time through the PR 13 resilient ladder
+  (:func:`~blades_tpu.sweeps.resilient.run_cells_resilient`): per-cell
+  deadline, bounded-backoff retry, poison-cell quarantine — so a poison
+  request yields an attributable per-cell error reply while its innocent
+  cells and every neighboring request complete.
+
+Crash semantics (docs/robustness.md "Simulation service"):
+
+- **SIGTERM = drain**: stop admitting, finish everything already
+  admitted (in-flight cells run to their journal boundary), reply to
+  waiting clients, exit 0 — zero lost requests by construction.
+- **SIGKILL = resume**: nothing in memory matters. The spool holds every
+  admitted request, each request's :class:`~blades_tpu.sweeps.journal
+  .SweepJournal` holds every completed cell, and the supervisor's
+  relaunch (``BLADES_RESUME=1``) re-queues the spool's pending requests;
+  re-execution recovers journaled cells and runs ONLY the remainder, so
+  the reply a client later fetches (``op: result``) is content-identical
+  to an uninterrupted run (pinned end-to-end in
+  ``tests/test_service.py``).
+
+The server beats ``BLADES_HEARTBEAT_FILE`` at every request-cell
+boundary and on every idle tick, so ``python -m blades_tpu.supervision``
+supervises it like any round loop; size ``--heartbeat-timeout`` to cover
+one cold cell compile, exactly as for a sweep (docs/robustness.md).
+Every request gets a ledger entry under the inherited ``run_id``
+(``telemetry/ledger.py``), and the trace
+(``<out>/service_trace.jsonl``) carries schema-locked ``service`` /
+``request`` / per-cell ``sweep`` records at the existing
+flush-at-cell-boundary cadence — ``scripts/sweep_status.py`` and
+``scripts/runs.py --run-id`` read service health (queue depth,
+in-flight/served/rejected/quarantined, oldest-pending age) from it live.
+
+Module scope is stdlib-only (IMP001): the jax-importing pieces (the
+``simulate`` handler, the resilient executor's retry-curve import chain)
+load inside the execution path, so a probe-only server — the chaos
+drills, admission-control tests, health probes — never pays the jax
+import on this 1-core box.
+
+Reference counterpart: none — the reference runs one configuration per
+cold process (``src/blades/simulator.py``); the admission/drain shape
+follows Bonawitz et al., 2019 (selection + aggregation as long-lived
+services with explicit pace steering).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import signal
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from blades_tpu.service import protocol as _protocol
+from blades_tpu.service.handlers import safe_name  # stdlib at module scope
+from blades_tpu.service.spool import RequestSpool
+from blades_tpu.supervision import heartbeat as _heartbeat
+from blades_tpu.telemetry import Recorder
+from blades_tpu.telemetry import context as _context
+from blades_tpu.telemetry import ledger as _ledger
+
+__all__ = ["SimulationService", "TRACE_NAME"]
+
+#: The service's telemetry trace filename inside its --out directory.
+TRACE_NAME = "service_trace.jsonl"
+
+#: Spool filename inside the --out directory.
+SPOOL_NAME = "spool.jsonl"
+
+
+class _LockedRecorder(Recorder):
+    """The service trace recorder, made thread-safe: the listener thread
+    (admission/reject records) and the worker (cell/request records, the
+    resilient executor's retry flushes) share one file-backed recorder,
+    and an unlocked flush race is exactly the torn-line interleaving the
+    O_APPEND journals guard against."""
+
+    def __init__(self, *a, **kw):
+        self._lock = threading.RLock()
+        super().__init__(*a, **kw)
+
+    def _emit(self, record):
+        with self._lock:
+            super()._emit(record)
+
+    def flush(self):
+        with self._lock:
+            super().flush()
+
+
+class _RequestAccounting:
+    """Per-cell accounting for one request: the ``sweep=`` adapter the
+    resilient executor drives. Emits one schema-locked ``sweep`` record
+    per cell (``sweep: "service"``, cell key ``<request_id>/<label>``,
+    i-of-N within the request), flushes at the cell boundary, and beats
+    the supervision heartbeat — a supervised server stays visibly alive
+    through a long request exactly like a sweep driver does."""
+
+    kind = "service"
+
+    def __init__(self, svc: "SimulationService", request_id: str, total: int):
+        self._svc = svc
+        self.rec = svc.rec
+        self.request_id = request_id
+        self.total = int(total)
+        self.done = 0
+
+    def record(
+        self,
+        key: str,
+        wall_s: float,
+        counter_delta: Optional[Dict[str, Any]] = None,
+        **fields,
+    ) -> None:
+        error = fields.pop("error", None)
+        error_type = fields.pop("error_type", None)
+        delta = dict(counter_delta or {})
+        self.done += 1
+        rec_fields: Dict[str, Any] = {
+            "sweep": self.kind,
+            "cell": f"{self.request_id}/{key}",
+            "ts": time.time(),
+            "i": self.done,
+            "total": self.total,
+            "wall_s": round(float(wall_s), 6),
+            "execute_s": round(
+                max(0.0, wall_s - delta.get("compile_s", 0.0)
+                    - delta.get("trace_s", 0.0)), 6,
+            ),
+            **delta,
+            **fields,
+        }
+        if error is not None:
+            rec_fields["ok"] = False
+            rec_fields["error"] = str(error)[:300]
+            if error_type is not None:
+                rec_fields.setdefault("error_type", error_type)
+        self.rec.event("sweep", **rec_fields)
+        self.rec.flush()
+        self._svc._beat()
+
+
+class SimulationService:
+    """One warm server process (see the module docstring).
+
+    Parameters
+    ----------
+    out_dir : the service directory — socket (by default), spool, trace,
+        per-request journals and log dirs all live under it.
+    socket_path : override the unix-socket path (``<out>/service.sock``).
+    max_queue : admission bound on QUEUED requests (in-flight excluded);
+        breaching it returns ``rejected: backpressure``.
+    attempts / base_delay_s / cell_deadline_s : the resilient ladder's
+        knobs, passed through to :class:`~blades_tpu.sweeps.resilient
+        .ResilienceOptions` — the per-request deadline is
+        ``cell_deadline_s`` per cell, i.e. scaled by cell count.
+    health_interval_s : cadence of idle ``service`` health records (a
+        wedged-vs-busy server must be distinguishable from the trace).
+    resume : replay the spool's pending requests before accepting new
+        ones; default reads ``BLADES_RESUME`` (the supervisor's relaunch
+        contract).
+    """
+
+    def __init__(
+        self,
+        out_dir: str,
+        socket_path: Optional[str] = None,
+        max_queue: int = 8,
+        attempts: int = 2,
+        base_delay_s: float = 0.5,
+        cell_deadline_s: Optional[float] = None,
+        health_interval_s: float = 30.0,
+        poll_s: float = 0.5,
+        resume: Optional[bool] = None,
+    ):
+        self.out_dir = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self.socket_path = _protocol.socket_path_for(out_dir, socket_path)
+        self.max_queue = int(max_queue)
+        self.attempts = int(attempts)
+        self.base_delay_s = float(base_delay_s)
+        self.cell_deadline_s = cell_deadline_s
+        self.health_interval_s = float(health_interval_s)
+        self.poll_s = float(poll_s)
+        if resume is None:
+            resume = os.environ.get(_heartbeat.RESUME_ENV) == "1"
+        self.resume = bool(resume)
+
+        self.ctx = _context.activate()
+        trace = os.path.join(out_dir, TRACE_NAME)
+        if not self.resume:
+            # a fresh service lifetime is a new trace; a resumed one
+            # APPENDS — one continuous trail across attempts
+            try:
+                os.unlink(trace)
+            except OSError:
+                pass
+        self.rec = _LockedRecorder(
+            path=trace,
+            meta={"run": "service", "socket": self.socket_path,
+                  "max_queue": self.max_queue},
+        )
+        self.rec.flush()  # the trace must be queryable before any request
+        self.spool = RequestSpool(
+            os.path.join(out_dir, SPOOL_NAME), resume=self.resume
+        )
+
+        # the warm caches the whole service exists to keep warm: engines
+        # (built lazily on the first simulate cell — probe-only servers
+        # never pay the import) and datasets (whose per-instance jitted
+        # samplers would otherwise re-trace every request), shared across
+        # every request for the process life
+        self._engine_cache = None
+        self._datasets: Dict[Any, Any] = {}
+
+        self._queue: "queue.Queue[Tuple[str, Dict[str, Any], Any]]" = (
+            queue.Queue()
+        )
+        self._draining = threading.Event()
+        self._drain_reason: Optional[str] = None
+        self._state_lock = threading.Lock()
+        self._pending_ts: Dict[str, float] = {}  # id -> admit time
+        self._in_flight: Optional[str] = None
+        self.served = 0
+        self.rejected = 0
+        self.quarantined_requests = 0
+        self.failed = 0
+        self.resumed_requests = 0
+        self.cells_done = 0
+        self._t0 = time.monotonic()
+        self._last_health = 0.0
+        self._sock: Optional[socket.socket] = None
+        self._listener: Optional[threading.Thread] = None
+        self._stop_listening = False
+
+    # -- shared emitters -------------------------------------------------------
+
+    def event(self, type_: str, **fields) -> None:
+        """Emit one service-trace record (+ flush — every service event
+        must be durably queryable by a live status probe). Named like
+        :meth:`Recorder.event` deliberately: the SCHEMA001 emit scan
+        keys on literal ``.event("<type>")`` calls, so records emitted
+        through this helper stay statically visible to the schema
+        gate."""
+        self.rec.event(type_, ts=time.time(), **fields)
+        self.rec.flush()
+
+    def _beat(self) -> None:
+        self.cells_done += 1
+        _heartbeat.beat(round_idx=self.cells_done)
+
+    def _snapshot(self) -> Dict[str, Any]:
+        with self._state_lock:
+            pending = dict(self._pending_ts)
+            in_flight = self._in_flight
+        now = time.time()
+        oldest = min(pending.values(), default=None)
+        return {
+            "queue_depth": self._queue.qsize(),
+            "in_flight": 1 if in_flight else 0,
+            "served": self.served,
+            "rejected": self.rejected,
+            "quarantined_requests": self.quarantined_requests,
+            "failed": self.failed,
+            "resumed": self.resumed_requests,
+            "oldest_pending_age_s": (
+                round(now - oldest, 3) if oldest is not None else None
+            ),
+            "draining": self._draining.is_set(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "pid": os.getpid(),
+            "run_id": self.ctx.run_id,
+        }
+
+    def _health(self, event: str = "health") -> None:
+        snap = self._snapshot()
+        self.event(
+            "service",
+            event=event,
+            queue_depth=snap["queue_depth"],
+            in_flight=snap["in_flight"],
+            served=snap["served"],
+            rejected=snap["rejected"],
+            quarantined_requests=snap["quarantined_requests"],
+            draining=snap["draining"],
+            uptime_s=snap["uptime_s"],
+            **(
+                {"oldest_pending_age_s": snap["oldest_pending_age_s"]}
+                if snap["oldest_pending_age_s"] is not None
+                else {}
+            ),
+        )
+        self._last_health = time.monotonic()
+
+    # -- listener --------------------------------------------------------------
+
+    def _listen(self) -> None:
+        assert self._sock is not None
+        while not self._stop_listening:
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                # the accept timeout is the stop-flag poll: closing the
+                # socket from the worker thread does NOT reliably wake a
+                # blocked accept on Linux, so a drain would otherwise
+                # stall until the join timeout
+                continue
+            except OSError:
+                return  # socket closed by the worker's exit path
+            try:
+                conn.settimeout(10.0)  # a mute client must not wedge accept
+                self._handle_conn(conn)
+            except Exception:  # noqa: BLE001 - one bad conn never kills serve
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _reply_and_close(self, f, conn, payload: Dict[str, Any]) -> None:
+        try:
+            _protocol.write_message(f, payload)
+        except OSError:
+            pass  # client gone; the spool still holds anything durable
+        finally:
+            try:
+                f.close()
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_conn(self, conn) -> None:
+        f = conn.makefile("rwb")
+        try:
+            msg = _protocol.read_message(f)
+        except _protocol.ProtocolError as e:
+            self._reply_and_close(f, conn, {"ok": False, "error": str(e)})
+            return
+        if msg is None:
+            self._reply_and_close(f, conn, {"ok": False, "error": "empty"})
+            return
+        op = msg.get("op")
+        if op == "ping":
+            self._reply_and_close(
+                f, conn,
+                {"ok": True, "pid": os.getpid(), "run_id": self.ctx.run_id},
+            )
+        elif op == "status":
+            self._reply_and_close(f, conn, {"ok": True, **self._snapshot()})
+        elif op == "result":
+            rid = str(msg.get("id") or "")
+            reply = self.spool.reply(rid)
+            if reply is not None:
+                self._reply_and_close(
+                    f, conn, {"ok": True, "status": "done", "reply": reply}
+                )
+            elif self.spool.has(rid):
+                self._reply_and_close(
+                    f, conn, {"ok": True, "status": "pending", "id": rid}
+                )
+            else:
+                self._reply_and_close(
+                    f, conn, {"ok": True, "status": "unknown", "id": rid}
+                )
+        elif op == "drain":
+            self._drain_reason = "drain_op"
+            self._draining.set()
+            self._reply_and_close(f, conn, {"ok": True, "draining": True})
+        elif op == "submit":
+            self._admit(msg, f, conn)
+        else:
+            self._reply_and_close(
+                f, conn, {"ok": False, "error": f"unknown op {op!r}"}
+            )
+
+    def _admit(self, msg: Dict[str, Any], f, conn) -> None:
+        request = msg.get("request")
+        if not isinstance(request, dict):
+            self._reply_and_close(
+                f, conn, {"ok": False, "error": "submit carries no request"}
+            )
+            return
+        rid = request.get("id")
+        if rid:
+            try:
+                # the id becomes the per-request journal/log dir segment
+                # — an unsafe one (path separators, '..') must be
+                # rejected at the door, before it is durably spooled
+                rid = safe_name(rid, "request id")
+            except ValueError as e:
+                self._reply_and_close(
+                    f, conn, {"ok": False, "error": str(e)}
+                )
+                return
+        else:
+            rid = None
+        # idempotent resubmission: a completed id is served from the
+        # spool (never re-executed), a pending one is not double-queued
+        if rid and self.spool.reply(rid) is not None:
+            self._reply_and_close(
+                f, conn,
+                {"ok": True, "status": "done", "id": rid, "served": "spool",
+                 "reply": self.spool.reply(rid)},
+            )
+            return
+        if rid and self.spool.has(rid):
+            self._reply_and_close(
+                f, conn, {"ok": True, "status": "pending", "id": rid}
+            )
+            return
+        if self._draining.is_set():
+            self.rejected += 1
+            self.event("service", event="reject", reason="draining",
+                        queue_depth=self._queue.qsize())
+            self._reply_and_close(
+                f, conn,
+                {"ok": False, "rejected": "draining",
+                 "error": "service is draining; not admitting requests"},
+            )
+            return
+        if self._queue.qsize() >= self.max_queue:
+            # admission control: bounded queue, explicit reply — the
+            # 1-core box must shed load, not absorb it into memory
+            self.rejected += 1
+            self.event("service", event="reject", reason="backpressure",
+                        queue_depth=self._queue.qsize())
+            self._reply_and_close(
+                f, conn,
+                {"ok": False, "rejected": "backpressure",
+                 "queue_depth": self._queue.qsize(),
+                 "max_queue": self.max_queue},
+            )
+            return
+        # spool FIRST, queue second: a crash between the two replays the
+        # request on resume; the reverse would acknowledge lost work
+        rid = self.spool.admit(request, request_id=rid)
+        with self._state_lock:
+            self._pending_ts[rid] = time.time()
+        self.event(
+            "request", event="admitted", id=rid,
+            kind=str(request.get("kind")),
+            cells=len(request.get("cells") or []),
+        )
+        if msg.get("wait", True):
+            self._queue.put((rid, request, (f, conn)))
+        else:
+            self._queue.put((rid, request, None))
+            self._reply_and_close(
+                f, conn, {"ok": True, "status": "accepted", "id": rid}
+            )
+
+    # -- worker ----------------------------------------------------------------
+
+    def _execute(self, rid: str, request: Dict[str, Any]) -> Dict[str, Any]:
+        """One request through the resilient ladder; returns the reply.
+        Never raises — a failure to even build the request becomes an
+        ``error`` reply, not a dead server."""
+        # the ladder imports stay function-scope so importing
+        # blades_tpu.service is pre-jax clean; the ladder itself is
+        # stdlib on the probe path (resilient.py lazy-imports the
+        # utils/retry curve), so a probe-only server never touches jax
+        from blades_tpu.service import handlers as _handlers
+        from blades_tpu.sweeps import program_fingerprint
+        from blades_tpu.sweeps.journal import SweepJournal
+        from blades_tpu.sweeps.resilient import (
+            ResilienceOptions,
+            run_cells_resilient,
+        )
+
+        t0 = time.perf_counter()
+        with self._state_lock:
+            admit_ts = self._pending_ts.get(rid)
+        queue_age = time.time() - admit_ts if admit_ts else None
+        entry = _ledger.run_started(
+            "request",
+            config={
+                "id": rid,
+                "kind": request.get("kind"),
+                "cells": len(request.get("cells") or []),
+            },
+        )
+        try:
+            cells = _handlers.build_cells(request)
+        except (ValueError, TypeError) as e:
+            self.failed += 1
+            error = f"{type(e).__name__}: {e}"[:300]
+            self.event("request", event="finished", id=rid,
+                        outcome="error", error=error,
+                        wall_s=round(time.perf_counter() - t0, 6))
+            entry.ended("crashed", error=error)
+            return {"ok": False, "id": rid, "status": "error",
+                    "error": error}
+        self.event(
+            "request", event="started", id=rid,
+            kind=str(request.get("kind")), cells=len(cells),
+            **({"queue_age_s": round(queue_age, 3)}
+               if queue_age is not None else {}),
+        )
+        if self._engine_cache is None:
+            from blades_tpu.sweeps import EngineCache
+
+            self._engine_cache = EngineCache()
+        # per-request journal: completed cells survive SIGKILL; the
+        # fingerprint guard keys on the request body, so a resumed id
+        # whose spooled body somehow drifted starts clean instead of
+        # stitching two different requests into one reply
+        journal = SweepJournal(
+            os.path.join(self.out_dir, "requests", rid, "journal.jsonl"),
+            fingerprint=program_fingerprint(request={
+                k: v for k, v in request.items() if k != "id"
+            }),
+            resume=True,
+        )
+        resumed_cells = sum(1 for lab, _ in cells if journal.has(lab))
+        if resumed_cells:
+            self.resumed_requests += 1
+        acct = _RequestAccounting(self, rid, total=len(cells))
+        runner = _handlers.make_runner(request, {
+            "cache": self._engine_cache,
+            "datasets": self._datasets,
+            "out_dir": self.out_dir,
+            "request_id": rid,
+        })
+        try:
+            results, _, report = run_cells_resilient(
+                cells,
+                runner,
+                sweep=acct,
+                journal=journal,
+                options=ResilienceOptions(
+                    attempts=self.attempts,
+                    base_delay_s=self.base_delay_s,
+                    cell_deadline_s=self.cell_deadline_s,
+                ),
+                kind="service",
+            )
+        except Exception as e:  # noqa: BLE001 - isolation: reply, don't die
+            self.failed += 1
+            error = f"{type(e).__name__}: {e}"[:300]
+            self.event("request", event="finished", id=rid,
+                        outcome="error", error=error,
+                        wall_s=round(time.perf_counter() - t0, 6))
+            entry.ended("crashed", error=error)
+            return {"ok": False, "id": rid, "status": "error",
+                    "error": error}
+        finally:
+            journal.close()
+        quarantined = {q["cell"]: q for q in report.quarantined}
+        out_cells: List[Dict[str, Any]] = []
+        for (label, _), res in zip(cells, results):
+            if res is None:
+                q = quarantined.get(label, {})
+                out_cells.append({
+                    "label": label,
+                    "quarantined": True,
+                    "error": q.get("error", "quarantined"),
+                    "error_type": q.get("error_type", "Exception"),
+                })
+            else:
+                out_cells.append({"label": label, "result": res})
+        wall = time.perf_counter() - t0
+        outcome = "quarantined" if quarantined else "ok"
+        if quarantined:
+            self.quarantined_requests += 1
+        self.served += 1
+        self.event(
+            "request", event="finished", id=rid, outcome=outcome,
+            cells=len(cells), executed=report.executed,
+            resumed_cells=report.resumed_skipped,
+            quarantined=len(quarantined), retried=report.retried,
+            wall_s=round(wall, 6),
+        )
+        entry.ended("finished", metrics={
+            "cells": len(cells),
+            "executed": report.executed,
+            "resumed_cells": report.resumed_skipped,
+            "quarantined": len(quarantined),
+            "retried": report.retried,
+        })
+        return {
+            "ok": not quarantined,
+            "id": rid,
+            "status": "done",
+            "kind": request.get("kind"),
+            "cells": out_cells,
+            "summary": report.summary(),
+        }
+
+    def _work(self) -> Dict[str, Any]:
+        while True:
+            try:
+                rid, request, waiter = self._queue.get(timeout=self.poll_s)
+            except queue.Empty:
+                self._beat_idle()
+                if self._draining.is_set() and self._queue.empty():
+                    # zero-lost-requests on drain needs ordering, not
+                    # luck: a listener mid-_admit may have passed its
+                    # draining check and be about to spool+queue one
+                    # more request. Stop the listener FIRST (close the
+                    # socket, join the thread — bounded by the conn
+                    # timeout), then re-check: anything it managed to
+                    # admit is in the queue now and loops back into
+                    # execution; only a truly empty queue exits.
+                    self._shutdown_listener()
+                    if self._queue.empty():
+                        break
+                continue
+            with self._state_lock:
+                self._in_flight = rid
+            reply = self._execute(rid, request)
+            # spool before replying: the reply must be fetchable (op:
+            # result) even if the waiting client died with the connection
+            self.spool.complete(rid, reply)
+            with self._state_lock:
+                self._in_flight = None
+                self._pending_ts.pop(rid, None)
+            if waiter is not None:
+                f, conn = waiter
+                self._reply_and_close(f, conn, reply)
+            self._health()
+        return self._snapshot()
+
+    def _shutdown_listener(self) -> None:
+        """Stop accepting: close the socket and join the listener thread
+        (idempotent). After this returns, no new request can enter the
+        queue — the drain exit check is race-free."""
+        if self._stop_listening:
+            return
+        self._stop_listening = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._listener is not None:
+            self._listener.join(timeout=30.0)
+
+    def _beat_idle(self) -> None:
+        # an idle supervised server is healthy, not hung: beat without
+        # advancing the cell counter
+        _heartbeat.beat(round_idx=self.cells_done)
+        if time.monotonic() - self._last_health > self.health_interval_s:
+            self._health()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def serve(self) -> Dict[str, Any]:
+        """Run until drained (SIGTERM or ``op: drain``); returns the final
+        snapshot. Call from the main thread — the per-cell soft deadline
+        and the SIGTERM drain handler both need it."""
+        prev_term = prev_int = None
+        if threading.current_thread() is threading.main_thread():
+            def _drain_signal(signum, frame):
+                self._drain_reason = signal.Signals(signum).name
+                self._draining.set()
+
+            prev_term = signal.signal(signal.SIGTERM, _drain_signal)
+            prev_int = signal.signal(signal.SIGINT, _drain_signal)
+
+        ledger_entry = _ledger.run_started(
+            "service",
+            config={
+                "kind": "service",
+                "max_queue": self.max_queue,
+                "attempts": self.attempts,
+                "cell_deadline_s": self.cell_deadline_s,
+            },
+            artifacts=[
+                os.path.join(self.out_dir, TRACE_NAME),
+                self.spool.path,
+            ],
+        )
+        # resume BEFORE listening: the interrupted lifetime's requests go
+        # to the head of the queue, then new admissions line up behind
+        pending = self.spool.pending() if self.resume else []
+        for rid, request in pending:
+            with self._state_lock:
+                self._pending_ts[rid] = time.time()
+            self._queue.put((rid, request, None))
+        self.event(
+            "service", event="start", socket=self.socket_path,
+            queue_depth=self._queue.qsize(),
+            resumed=len(pending), pid=os.getpid(),
+        )
+
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.socket_path)
+        self._sock.listen(16)
+        self._sock.settimeout(self.poll_s)  # see _listen: stop-flag poll
+        self._stop_listening = False
+        self._listener = threading.Thread(
+            target=self._listen, name="service-listener", daemon=True
+        )
+        self._listener.start()
+
+        outcome = "finished"
+        try:
+            snap = self._work()
+        except BaseException as e:
+            outcome = "crashed"
+            ledger_entry.ended("crashed", error=f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            self._stop_listening = True
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+            if outcome == "finished":
+                self.event(
+                    "service", event="exit",
+                    reason=self._drain_reason or "drain",
+                    served=self.served, rejected=self.rejected,
+                    quarantined_requests=self.quarantined_requests,
+                )
+            self.rec.close()
+            self.spool.close()
+            # restore on EVERY path: a crashed service leaving its drain
+            # handlers installed would make every later SIGINT/SIGTERM
+            # set a defunct event instead of interrupting the process
+            if prev_term is not None:
+                signal.signal(signal.SIGTERM, prev_term)
+            if prev_int is not None:
+                signal.signal(signal.SIGINT, prev_int)
+        ledger_entry.ended("finished", metrics={
+            "served": self.served,
+            "rejected": self.rejected,
+            "quarantined_requests": self.quarantined_requests,
+            "resumed": self.resumed_requests,
+        })
+        return snap

@@ -1,0 +1,188 @@
+"""Crash-safe on-disk request spool: the server's admission journal.
+
+The request spool is to REQUESTS what :class:`~blades_tpu.sweeps.journal
+.SweepJournal` is to cells: one JSON line per event, appended durably at
+the moment the event happens, so a SIGKILLed server loses nothing it
+acknowledged. Two record kinds:
+
+- ``{"kind": "request", "id", "ts", "request": {...}}`` — appended BEFORE
+  the request enters the in-memory queue (spool first, queue second: a
+  crash between the two replays the request on resume; the reverse order
+  would acknowledge work that no longer exists);
+- ``{"kind": "done", "id", "ts", "reply": {...}}`` — the full
+  client-visible reply, appended at completion (after the per-cell
+  journal already holds every cell result, so a crash between journal
+  and spool re-assembles the same reply from journaled cells).
+
+A relaunch under ``BLADES_RESUME=1`` loads the spool and re-queues every
+admitted-but-not-done request in admission order; each request's own
+cell journal then recovers its completed cells, so the relaunch executes
+only the remainder and the reply is content-identical to an
+uninterrupted run. A fresh (non-resume) start truncates the spool — old
+requests belong to the previous service lifetime. Completed replies stay
+fetchable (``op: result``) for the whole service lifetime either way:
+the spool is the reply store, not just the recovery log.
+
+Append discipline: one ``os.write`` of one whole line on an ``O_APPEND``
+fd under an flock — the same concurrent-append safety as the sweep
+journal and the run ledger (PR 14), because the admission (listener)
+thread and the execution (main) thread share this file, and a supervisor
+relaunch can briefly overlap the reaped attempt's last write.
+
+Stdlib-only, importable before jax (IMP001). Reference counterpart: none
+— the reference has no request surface (``src/blades/simulator.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from blades_tpu.service.protocol import mint_request_id
+
+__all__ = ["RequestSpool"]
+
+
+class RequestSpool:
+    """Append-only request/reply spool with resume.
+
+    ``resume=False`` (a fresh service start) truncates any existing
+    spool; ``resume=True`` loads it — admitted requests, completed
+    replies — and :meth:`pending` yields what the interrupted lifetime
+    still owed.
+    """
+
+    def __init__(self, path: str, resume: bool = False):
+        self.path = path
+        self.resumed = False
+        self._requests: Dict[str, Dict[str, Any]] = {}
+        self._replies: Dict[str, Dict[str, Any]] = {}
+        self._order: List[str] = []
+        self._fd: Optional[int] = None
+        self._lock = threading.Lock()
+        if resume and os.path.exists(path):
+            for rec in _load_lines(path):
+                rid = rec.get("id")
+                if not isinstance(rid, str):
+                    continue
+                if rec.get("kind") == "request" and "request" in rec:
+                    if rid not in self._requests:
+                        self._order.append(rid)
+                    self._requests[rid] = rec["request"]
+                elif rec.get("kind") == "done" and "reply" in rec:
+                    self._replies[rid] = rec["reply"]
+            self.resumed = bool(self._requests or self._replies)
+        if not self.resumed:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    # -- state ----------------------------------------------------------------
+
+    def has(self, request_id: str) -> bool:
+        return request_id in self._requests
+
+    def reply(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """The completed reply for one request, or None while pending/
+        unknown."""
+        return self._replies.get(request_id)
+
+    def pending(self) -> List[Tuple[str, Dict[str, Any]]]:
+        """Admitted-but-not-done requests, admission order — what a
+        resumed server must re-queue."""
+        return [
+            (rid, self._requests[rid])
+            for rid in self._order
+            if rid not in self._replies
+        ]
+
+    def counts(self) -> Dict[str, int]:
+        return {
+            "admitted": len(self._requests),
+            "done": len(self._replies),
+            "pending": sum(
+                1 for r in self._requests if r not in self._replies
+            ),
+        }
+
+    def __len__(self) -> int:
+        return len(self._requests)
+
+    # -- recording ------------------------------------------------------------
+
+    def admit(
+        self, request: Dict[str, Any], request_id: Optional[str] = None
+    ) -> str:
+        """Durably record one admitted request; returns its id. Must be
+        called BEFORE the request enters the in-memory queue."""
+        rid = request_id or mint_request_id()
+        with self._lock:
+            if rid not in self._requests:
+                self._order.append(rid)
+            self._requests[rid] = request
+            self._append({
+                "kind": "request", "id": rid, "ts": time.time(),
+                "request": request,
+            })
+        return rid
+
+    def complete(self, request_id: str, reply: Dict[str, Any]) -> None:
+        """Durably record one request's client-visible reply."""
+        with self._lock:
+            self._replies[request_id] = reply
+            self._append({
+                "kind": "done", "id": request_id, "ts": time.time(),
+                "reply": reply,
+            })
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                try:
+                    os.close(self._fd)
+                except OSError:
+                    pass
+                self._fd = None
+
+    # -- internals ------------------------------------------------------------
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        # same whole-line O_APPEND single-write + flock discipline as the
+        # sweep journal (blades_tpu/sweeps/journal.py) — the listener and
+        # worker threads share this fd, and a supervisor relaunch can
+        # overlap the previous attempt's final write
+        from blades_tpu.sweeps.journal import _locked_write
+
+        if self._fd is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._fd = os.open(
+                self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+            )
+        _locked_write(self._fd, (json.dumps(rec, default=repr) + "\n").encode())
+
+
+def _load_lines(path: str) -> List[Dict[str, Any]]:
+    """Parse the spool, skipping blank/torn lines (the writer may have
+    been SIGKILLed mid-append — surviving that is the spool's job)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        return []
+    return out

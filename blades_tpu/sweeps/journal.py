@@ -35,6 +35,17 @@ and live next to — never inside — ``sweep_trace.jsonl``, so the
 schema-locked telemetry surface (SCHEMA001, ``docs/telemetry_schema
 .json``) stays closed while result payloads stay unconstrained.
 
+Concurrent-append safety (PR 14): the simulation service and the
+supervisor's relaunch window can briefly leave TWO processes holding the
+same journal (the reaped attempt's final buffered write racing the
+relaunch's first), and a buffered ``file.write`` may split one long line
+across several ``write(2)`` calls — an interleaved torn line then eats a
+NEIGHBOR's record, not just its own. Every append is therefore one
+``os.write`` of one whole encoded line to an ``O_APPEND`` fd (the kernel
+serializes the offset), under a best-effort ``fcntl.flock`` advisory
+lock for the multi-writer case (``tests/test_service.py``
+``test_interleaved_journal_writers``).
+
 Reference counterpart: none — the reference runs one configuration per
 process and restarts any failure from scratch (``src/blades/
 simulator.py``).
@@ -88,7 +99,7 @@ class SweepJournal:
         self.resumed = False
         self._entries: Dict[str, Dict[str, Any]] = {}
         self._quarantined: Dict[str, Dict[str, Any]] = {}
-        self._fh = None
+        self._fd: Optional[int] = None
         if resume and os.path.exists(path):
             loaded = _load_lines(path)
             meta = next((r for r in loaded if r.get("kind") == "meta"), None)
@@ -184,12 +195,12 @@ class SweepJournal:
         self._maybe_kill()
 
     def close(self) -> None:
-        if self._fh is not None:
+        if self._fd is not None:
             try:
-                self._fh.close()
+                os.close(self._fd)
             except OSError:
                 pass
-            self._fh = None
+            self._fd = None
 
     # -- internals -----------------------------------------------------------
 
@@ -208,17 +219,25 @@ class SweepJournal:
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
-        self._fh = open(self.path, "a")
+        # O_APPEND: the kernel serializes the write offset across every fd
+        # on this file, so concurrent appenders (server + a not-yet-reaped
+        # previous attempt) cannot overwrite each other's tails
+        self._fd = os.open(
+            self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+        )
 
     def _append(self, entry: Dict[str, Any]) -> None:
-        if self._fh is None:
+        if self._fd is None:
             self._open()
-        self._fh.write(json.dumps(entry, default=_json_default) + "\n")
-        # flush through the Python buffer at every cell boundary: data in
-        # the OS page cache survives SIGKILL; data in the interpreter does
-        # not. Cells run seconds-to-minutes — one flush each is the
-        # existing once-per-round discipline, not a hot path.
-        self._fh.flush()
+        # ONE write(2) per record: a whole line lands atomically or (on a
+        # mid-write SIGKILL) as the single torn tail _load_lines skips —
+        # never interleaved with another writer's line. os.write bypasses
+        # the interpreter buffer, so the line is in the OS page cache (and
+        # SIGKILL-durable) the moment this returns. Cells run
+        # seconds-to-minutes — one syscall each is the existing
+        # once-per-round discipline, not a hot path.
+        data = (json.dumps(entry, default=_json_default) + "\n").encode()
+        _locked_write(self._fd, data)
 
     def _maybe_kill(self) -> None:
         """The test saboteur (see :data:`KILL_AT_ENV`)."""
@@ -234,6 +253,32 @@ class SweepJournal:
             return
         open(self._sentinel, "w").close()
         os.kill(os.getpid(), signal.SIGKILL)  # no autosave, no cleanup
+
+
+def _locked_write(fd: int, data: bytes) -> None:
+    """One whole-line append under a best-effort advisory lock.
+
+    The single ``os.write`` on an ``O_APPEND`` fd is the real torn-line
+    defense (atomic offset, one syscall); the ``flock`` adds cross-process
+    mutual exclusion for filesystems/sizes where a single ``write(2)`` is
+    not guaranteed indivisible. Lock failures (NFS without lockd, EINTR)
+    degrade to the unlocked single write rather than losing the record."""
+    import fcntl
+
+    locked = False
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        locked = True
+    except OSError:
+        pass
+    try:
+        os.write(fd, data)
+    finally:
+        if locked:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            except OSError:
+                pass
 
 
 def _load_lines(path: str) -> List[Dict[str, Any]]:

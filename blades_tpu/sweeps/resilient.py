@@ -82,7 +82,17 @@ from blades_tpu.sweeps import SweepCell, _execute_group, plan_groups
 from blades_tpu.sweeps.journal import SweepJournal
 from blades_tpu.telemetry import recorder as _trecorder
 from blades_tpu.telemetry.timeline import _counter_delta
-from blades_tpu.utils.retry import backoff_delay
+
+
+def backoff_delay(attempt: int, base_delay_s: float, max_delay_s: float):
+    """The shared ``utils/retry.py`` curve, imported lazily: the
+    ``blades_tpu.utils`` package chain pulls jax (same constraint the
+    supervisor documents), and this module otherwise runs stdlib-only —
+    the simulation service's probe requests execute the full resilient
+    ladder without ever importing jax."""
+    from blades_tpu.utils.retry import backoff_delay as _delay
+
+    return _delay(attempt, base_delay_s, max_delay_s)
 
 __all__ = [
     "DeadlineExceeded",

@@ -163,13 +163,27 @@ def env_fingerprint() -> Dict[str, Any]:
 
 
 def _append(path: str, record: Dict[str, Any]) -> bool:
-    """One buffered append of one JSONL record; never raises."""
+    """One whole-line append of one JSONL record; never raises.
+
+    One ``os.write`` on an ``O_APPEND`` fd, not a buffered ``file.write``:
+    the ledger is multi-writer by design (the supervisor's ``killed``
+    record races the reaped child's own buffered exit write; the
+    simulation service appends per-request entries while its supervisor
+    appends attempt records), and a buffered write may split one line
+    across several ``write(2)`` calls — an interleaved torn line would eat
+    a NEIGHBOR's record. The kernel serializes O_APPEND offsets, so whole
+    single-write lines cannot interleave
+    (``tests/test_service.py::test_interleaved_ledger_writers``)."""
     try:
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        with open(path, "a") as fh:
-            fh.write(json.dumps(record, default=repr) + "\n")
+        data = (json.dumps(record, default=repr) + "\n").encode()
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
         return True
     except (OSError, TypeError, ValueError):
         return False

@@ -78,6 +78,8 @@ GALLERY = [
       "POD_SAMPLES": "8", "XLA_FLAGS": MESH_FLAGS}, 900),
     ("long_context.py", [],
      {"LC_SEQ": "128", "LC_BATCH": "2", "XLA_FLAGS": MESH_FLAGS}, 900),
+    ("service_client.py", ["--out", "@TMP@/service_demo"],
+     {"SC_ROUNDS": "2"}, 900),
 ]
 
 API_MODULES = [
@@ -121,6 +123,12 @@ API_MODULES = [
     "blades_tpu.utils.retry",
     "blades_tpu.supervision.supervisor",
     "blades_tpu.supervision.heartbeat",
+    "blades_tpu.service",
+    "blades_tpu.service.server",
+    "blades_tpu.service.client",
+    "blades_tpu.service.protocol",
+    "blades_tpu.service.spool",
+    "blades_tpu.service.handlers",
     "blades_tpu.leaf",
     "blades_tpu.leaf.preprocess",
 ]
@@ -195,34 +203,81 @@ def check_gallery_covers_examples() -> None:
         )
 
 
-def build_gallery() -> None:
+def _example_section(name, argv, env, timeout, tmp, assets) -> str:
+    """One executed example's gallery section (markdown)."""
+    out = io.StringIO()
+    title, body = _docstring(os.path.join(EXAMPLES, name))
+    tail, images = run_example(name, argv, env, timeout, tmp)
+    out.write(f"## {title}\n\n")
+    if body:
+        out.write(body + "\n\n")
+    out.write(f"Source: [`examples/{name}`](../examples/{name})\n\n")
+    if tail.strip():
+        out.write("Output (reduced doc-build config):\n\n```text\n"
+                  + tail + "\n```\n\n")
+    for img in images:
+        dst = os.path.join(assets, f"{name[:-3]}_{os.path.basename(img)}")
+        shutil.copyfile(img, dst)
+        rel = os.path.relpath(dst, DOCS)
+        out.write(f"![{os.path.basename(dst)}]({rel})\n\n")
+    return out.getvalue()
+
+
+GALLERY_HEADER = (
+    "# Example gallery\n\n*Generated by `python docs/build.py` — every "
+    "example below was **executed** during the doc build (the "
+    "reference's sphinx-gallery contract, `docs/source/conf.py:41-75`); "
+    "a failing example fails the build.*\n\n"
+)
+
+
+def build_gallery(only=None) -> None:
+    """Execute the gallery and (re)write ``docs/gallery.md``.
+
+    ``only`` (a set of example filenames) executes just those and splices
+    their refreshed sections into the existing gallery, preserving every
+    other section verbatim — the incremental path for adding one example
+    without re-running the whole (hour-scale, 1-core) gallery. A full
+    build (``only=None``) still executes everything.
+    """
     assets = os.path.join(DOCS, "assets", "gallery")
     os.makedirs(assets, exist_ok=True)
+    gallery_path = os.path.join(DOCS, "gallery.md")
+    existing: dict = {}
+    if only:
+        unknown = set(only) - {name for name, _, _, _ in GALLERY}
+        if unknown:
+            # fail loud: a typo'd --only would otherwise splice every
+            # existing section verbatim, execute nothing, and exit 0
+            raise SystemExit(
+                f"--only names not in GALLERY: {sorted(unknown)}"
+            )
+        try:
+            text = open(gallery_path).read()
+        except OSError:
+            raise SystemExit(
+                "--only needs an existing docs/gallery.md to splice into; "
+                "run a full build first"
+            )
+        for chunk in text.split("\n## ")[1:]:
+            title = chunk.splitlines()[0].strip()
+            existing[title] = "## " + chunk.rstrip("\n") + "\n\n"
     out = io.StringIO()
-    out.write(
-        "# Example gallery\n\n*Generated by `python docs/build.py` — every "
-        "example below was **executed** during the doc build (the "
-        "reference's sphinx-gallery contract, `docs/source/conf.py:41-75`); "
-        "a failing example fails the build.*\n\n"
-    )
+    out.write(GALLERY_HEADER)
     with tempfile.TemporaryDirectory() as tmp:
         for name, argv, env, timeout in GALLERY:
+            title, _ = _docstring(os.path.join(EXAMPLES, name))
+            if only and name not in only:
+                if title not in existing:
+                    raise SystemExit(
+                        f"--only: no existing gallery section for {name} "
+                        f"({title!r}); run a full build"
+                    )
+                out.write(existing[title])
+                continue
             print(f"[gallery] running {name} ...", flush=True)
-            title, body = _docstring(os.path.join(EXAMPLES, name))
-            tail, images = run_example(name, argv, env, timeout, tmp)
-            out.write(f"## {title}\n\n")
-            if body:
-                out.write(body + "\n\n")
-            out.write(f"Source: [`examples/{name}`](../examples/{name})\n\n")
-            if tail.strip():
-                out.write("Output (reduced doc-build config):\n\n```text\n"
-                          + tail + "\n```\n\n")
-            for img in images:
-                dst = os.path.join(assets, f"{name[:-3]}_{os.path.basename(img)}")
-                shutil.copyfile(img, dst)
-                rel = os.path.relpath(dst, DOCS)
-                out.write(f"![{os.path.basename(dst)}]({rel})\n\n")
-    with open(os.path.join(DOCS, "gallery.md"), "w") as f:
+            out.write(_example_section(name, argv, env, timeout, tmp, assets))
+    with open(gallery_path, "w") as f:
         f.write(out.getvalue())
     print("[gallery] wrote docs/gallery.md")
 
@@ -271,8 +326,18 @@ def build_api() -> None:
 
 
 if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--only", action="append", default=[], metavar="EXAMPLE.py",
+        help="execute only these examples, splicing their refreshed "
+             "sections into the existing gallery (api.md still rebuilds "
+             "fully — it is cheap); repeatable",
+    )
+    cli = parser.parse_args()
     sys.path.insert(0, REPO)
     check_gallery_covers_examples()
     build_api()
-    build_gallery()
+    build_gallery(only=set(cli.only) or None)
     print("docs build OK")

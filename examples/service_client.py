@@ -1,0 +1,103 @@
+"""Simulation service: submit experiments to a warm, crash-tolerant server.
+
+Starts the long-lived simulation service (``blades_tpu/service``,
+``scripts/serve.py``) as a subprocess, then drives it as a client over
+its unix-domain socket:
+
+1. a ``probe`` request — stdlib-only cells, served before jax is even
+   imported in the server (health checks and chaos drills use these);
+2. a ``probe`` request carrying a poison cell — quarantined with an
+   attributable error while its sibling cells complete (the PR 13
+   resilient ladder, request-scoped);
+3. two IDENTICAL ``simulate`` requests — real federated rounds on the
+   seeded synthetic dataset; the second is served from the warm
+   ``EngineCache`` with zero new compiles and must return bit-identical
+   results (the warm-serving claim ``perf_report.py --check`` gates);
+4. a live health snapshot (``op: status``) and a graceful drain — the
+   server finishes everything admitted and exits 0.
+
+Every admitted request is journaled to an on-disk spool first, so a
+SIGKILLed server replays it on relaunch under ``BLADES_RESUME=1`` and
+executes only the unjournaled cells (docs/robustness.md "Simulation
+service").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default=os.path.join(REPO, "outputs", "service_demo"))
+    p.add_argument("--rounds", type=int,
+                   default=int(os.environ.get("SC_ROUNDS", "2")))
+    args = p.parse_args()
+
+    from blades_tpu.service.client import ServiceClient
+    from blades_tpu.service.protocol import socket_path_for
+
+    server = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts", "serve.py"), "start",
+         "--out", args.out, "--devices", "1", "--base-delay", "0.1"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    client = ServiceClient(
+        socket_path_for(args.out), timeout=600,
+        connect_retries=50, connect_delay_s=0.2,
+    )
+    try:
+        _drive(client, args)
+        print("drain ->", json.dumps(client.drain()))
+        out, _ = server.communicate(timeout=120)
+        print("server exit:", server.returncode)
+        print("server summary:", out.strip())
+    finally:
+        # a failure anywhere above must not leak a live server holding
+        # the socket (the doc build executes this on a 1-core box)
+        if server.poll() is None:
+            server.kill()
+            server.communicate()
+
+
+def _drive(client, args) -> None:
+    print("ping ->", json.dumps(client.ping()))
+
+    probe = client.submit({"kind": "probe", "cells": [
+        {"label": "hello", "op": "ok", "value": 42},
+    ]})
+    print("probe ->", json.dumps(probe["cells"]))
+
+    poison = client.submit({"kind": "probe", "cells": [
+        {"label": "good", "op": "ok", "value": 1},
+        {"label": "bad", "op": "fail", "message": "intentionally poisoned"},
+    ]})
+    bad = next(c for c in poison["cells"] if c["label"] == "bad")
+    good = next(c for c in poison["cells"] if c["label"] == "good")
+    print(f"poison -> bad quarantined ({bad['error_type']}), "
+          f"good served: {json.dumps(good['result'])}")
+
+    simulate = {"kind": "simulate", "cells": [
+        {"label": "mean", "agg": "mean", "rounds": args.rounds, "seed": 11},
+        {"label": "median", "agg": "median", "rounds": args.rounds,
+         "seed": 11},
+    ]}
+    cold = client.submit(simulate, timeout=600)
+    warm = client.submit(simulate, timeout=600)
+    print("simulate (cold) ->", json.dumps(cold["cells"]))
+    print("warm repeat bit-identical:", cold["cells"] == warm["cells"])
+
+    status = client.status()
+    print("status -> served={served} rejected={rejected} "
+          "quarantined_requests={quarantined_requests}".format(**status))
+
+
+if __name__ == "__main__":
+    main()

@@ -546,6 +546,289 @@ def sweep(
     }
 
 
+# -- service slice (blades_tpu/service) ---------------------------------------
+# Chaos drills against the simulation service: each scenario launches a
+# real server subprocess (probe requests only — no jax, so a server
+# starts in interpreter-import time) and asserts the request-level
+# robustness contract end to end: a poison request is quarantined with an
+# attributable error while its siblings and neighbors complete; the
+# admission bound sheds load with an explicit backpressure reply; a hung
+# cell trips the per-cell deadline and is quarantined without wedging the
+# server; drain exits 0 with zero lost requests; and (full slice) SIGKILL
+# mid-request + supervised relaunch resumes from spool+journal, executes
+# only the unjournaled cells, and replies content-identically.
+
+SERVE = os.path.join(REPO, "scripts", "serve.py")
+
+
+def _start_server(out_dir: str, extra_args=(), env_extra=None):
+    """A service subprocess + connected client (probe-ready in ~1s)."""
+    import subprocess
+
+    from blades_tpu.service.client import ServiceClient
+    from blades_tpu.service.protocol import socket_path_for
+
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [sys.executable, SERVE, "start", "--out", out_dir,
+         "--base-delay", "0.05", *extra_args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    client = ServiceClient(
+        socket_path_for(out_dir), timeout=60,
+        connect_retries=50, connect_delay_s=0.2,
+    )
+    return proc, client
+
+
+def _finish_server(proc, client) -> int:
+    """Drain (if still up) and reap; returns the server's exit code."""
+    from blades_tpu.service.client import ServiceClient
+
+    if proc.poll() is None:
+        try:
+            # a short-fused client: the scenario's own client may carry a
+            # long relaunch-window retry budget, and burning it against a
+            # server that already exited would stall the whole slice
+            ServiceClient(
+                client.socket_path, timeout=10, connect_retries=2,
+                connect_delay_s=0.1,
+            ).drain()
+        except Exception:  # noqa: BLE001 - may already be draining/exited
+            pass
+    try:
+        proc.communicate(timeout=60)
+    except Exception:  # noqa: BLE001 - reap hard rather than leak
+        proc.kill()
+        proc.communicate()
+    return proc.returncode
+
+
+def _scn_poison(out_dir: str) -> dict:
+    """A poison request is quarantined (attributable error) while its
+    innocent cells and a neighboring request complete untouched."""
+    proc, client = _start_server(os.path.join(out_dir, "poison"))
+    try:
+        neighbor = client.submit(
+            {"kind": "probe", "cells": [{"label": "n0", "op": "ok"}]},
+            wait=False,
+        )
+        poison = client.submit({"kind": "probe", "cells": [
+            {"label": "good0", "op": "ok", "value": 1},
+            {"label": "bad", "op": "fail", "message": "poison cell"},
+            {"label": "good1", "op": "ok", "value": 2},
+        ]})
+        neighbor_reply = client.wait_result(neighbor["id"], timeout=30)
+        after = client.submit(
+            {"kind": "probe", "cells": [{"label": "a0", "op": "ok"}]}
+        )
+        cells = {c["label"]: c for c in poison.get("cells", [])}
+        ok = (
+            poison.get("status") == "done"
+            and not poison.get("ok")
+            and cells["bad"].get("quarantined")
+            and "poison cell" in cells["bad"].get("error", "")
+            and cells["bad"].get("error_type") == "RuntimeError"
+            and "result" in cells["good0"] and "result" in cells["good1"]
+            and neighbor_reply["reply"]["ok"]
+            and after.get("ok")
+        )
+        return {"name": "poison_isolated", "ok": bool(ok),
+                "quarantined": [c for c in cells if cells[c].get("quarantined")]}
+    finally:
+        _finish_server(proc, client)
+
+
+def _scn_backpressure(out_dir: str) -> dict:
+    """The admission bound sheds load with an explicit reply instead of
+    absorbing unbounded queue into memory."""
+    import time as _time
+
+    proc, client = _start_server(
+        os.path.join(out_dir, "backpressure"), ("--max-queue", "1"),
+    )
+    try:
+        busy = client.submit(
+            {"kind": "probe",
+             "cells": [{"label": "s", "op": "sleep", "sleep_s": 2.0}]},
+            wait=False,
+        )
+        _time.sleep(0.2)  # let the worker pick the sleeper up
+        queued = client.submit(
+            {"kind": "probe", "cells": [{"label": "q", "op": "ok"}]},
+            wait=False,
+        )
+        rejected = client.submit(
+            {"kind": "probe", "cells": [{"label": "r", "op": "ok"}]},
+            wait=False,
+        )
+        drained = client.wait_result(queued["id"], timeout=30)
+        ok = (
+            busy.get("status") == "accepted"
+            and queued.get("status") == "accepted"
+            and rejected.get("rejected") == "backpressure"
+            and drained["reply"]["ok"]
+        )
+        return {"name": "backpressure", "ok": bool(ok),
+                "rejected_reply": rejected}
+    finally:
+        _finish_server(proc, client)
+
+
+def _scn_deadline(out_dir: str) -> dict:
+    """A hung cell trips the per-cell soft deadline, is retried then
+    quarantined — and the server keeps serving."""
+    proc, client = _start_server(
+        os.path.join(out_dir, "deadline"),
+        ("--cell-deadline", "0.3", "--attempts", "2"),
+    )
+    try:
+        hung = client.submit({"kind": "probe", "cells": [
+            {"label": "hang", "op": "sleep", "sleep_s": 60},
+            {"label": "after", "op": "ok", "value": 7},
+        ]}, timeout=60)
+        alive = client.submit(
+            {"kind": "probe", "cells": [{"label": "ok", "op": "ok"}]}
+        )
+        cells = {c["label"]: c for c in hung.get("cells", [])}
+        ok = (
+            hung.get("status") == "done"
+            and cells["hang"].get("quarantined")
+            and cells["hang"].get("error_type") == "DeadlineExceeded"
+            and cells["after"].get("result", {}).get("value") == 7
+            and alive.get("ok")
+        )
+        return {"name": "deadline_hang", "ok": bool(ok)}
+    finally:
+        _finish_server(proc, client)
+
+
+def _scn_drain(out_dir: str) -> dict:
+    """Drain exits 0 with zero lost requests: everything admitted before
+    the drain is executed and its reply is durably in the spool."""
+    from blades_tpu.service.spool import RequestSpool
+
+    served_dir = os.path.join(out_dir, "drain")
+    proc, client = _start_server(served_dir)
+    try:
+        ids = [
+            client.submit(
+                {"kind": "probe",
+                 "cells": [{"label": f"c{i}", "op": "ok", "value": i}]},
+                wait=False,
+            )["id"]
+            for i in range(3)
+        ]
+        client.drain()
+    except BaseException:
+        _finish_server(proc, client)
+        raise
+    rc = _finish_server(proc, client)
+    spool = RequestSpool(
+        os.path.join(served_dir, "spool.jsonl"), resume=True
+    )
+    replies = {rid: spool.reply(rid) for rid in ids}
+    spool.close()
+    ok = rc == 0 and all(
+        r is not None and r.get("ok") for r in replies.values()
+    )
+    return {"name": "drain_no_loss", "ok": bool(ok), "rc": rc,
+            "requests": len(ids)}
+
+
+def _scn_sigkill_resume(out_dir: str) -> dict:
+    """SIGKILL the supervised server mid-request; the relaunch resumes
+    from spool+journal, executes ONLY the unjournaled cells, and the
+    client-visible reply is content-identical to an uninterrupted run."""
+    import subprocess
+
+    from blades_tpu.service.client import ServiceClient
+    from blades_tpu.service.protocol import mint_request_id, socket_path_for
+    from blades_tpu.sweeps.journal import KILL_AT_ENV
+
+    request = {"kind": "probe", "cells": [
+        {"label": f"c{i}", "op": "ok", "value": i} for i in range(4)
+    ]}
+    # reference: an uninterrupted server
+    ref_dir = os.path.join(out_dir, "kill_ref")
+    proc, client = _start_server(ref_dir)
+    try:
+        ref = client.submit(request, request_id="kill-ref")
+    finally:
+        _finish_server(proc, client)
+
+    # supervised server that SIGKILLs itself after the 2nd journaled cell
+    sup_dir = os.path.join(out_dir, "kill_sup")
+    env = dict(os.environ)
+    env[KILL_AT_ENV] = "2"
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "blades_tpu.supervision", "--attempts", "2",
+         "--heartbeat-timeout", "120", "--base-delay", "0.1",
+         "--heartbeat-file", os.path.join(out_dir, "kill_hb"),
+         "--", sys.executable, SERVE, "start", "--out", sup_dir,
+         "--base-delay", "0.05"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    client = ServiceClient(
+        socket_path_for(sup_dir), timeout=60,
+        connect_retries=100, connect_delay_s=0.2,
+    )
+    rid = mint_request_id()
+    try:
+        try:
+            client.submit(request, request_id=rid)
+        except Exception:  # noqa: BLE001 - the conn dies with the SIGKILL
+            pass
+        recovered = client.wait_result(rid, timeout=120)
+        client.drain()
+    finally:
+        try:
+            sup.communicate(timeout=120)
+        except Exception:  # noqa: BLE001 - reap hard rather than leak
+            sup.kill()
+            sup.communicate()
+    reply = recovered["reply"]
+    summary = reply.get("summary", {})
+    ok = (
+        sup.returncode == 0
+        and reply["cells"] == ref["cells"]
+        and summary.get("resumed_skipped", 0) >= 1
+        and summary.get("executed", 9) <= len(request["cells"]) - 1
+    )
+    return {
+        "name": "sigkill_resume", "ok": bool(ok),
+        "supervisor_rc": sup.returncode,
+        "resumed_skipped": summary.get("resumed_skipped"),
+        "executed": summary.get("executed"),
+        "content_identical": reply["cells"] == ref["cells"],
+    }
+
+
+def service_chaos(out_dir: str, full: bool = False) -> dict:
+    """The service chaos slice; returns a summary dict (one JSON line via
+    ``main``). Reduced (tier-1) runs the in-process-cheap drills; the
+    full slice adds the supervised SIGKILL-resume scenario
+    (``results/chaos_sweep.json`` carries the committed evidence)."""
+    scenarios = [_scn_poison, _scn_backpressure, _scn_deadline, _scn_drain]
+    if full:
+        scenarios.append(_scn_sigkill_resume)
+    rows = []
+    for scn in scenarios:
+        try:
+            rows.append(scn(out_dir))
+        except Exception as e:  # noqa: BLE001 - a failed drill is a row
+            rows.append({
+                "name": scn.__name__.replace("_scn_", ""), "ok": False,
+                "error": f"{type(e).__name__}: {e}"[:300],
+            })
+    return {
+        "metric": "chaos_service",
+        "scenarios": rows,
+        "ok": all(r["ok"] for r in rows),
+    }
+
+
 # -- supervised child ---------------------------------------------------------
 
 
@@ -610,6 +893,12 @@ def main() -> int:
     p.add_argument("--kill-at", type=int, default=None)
     p.add_argument("--hang-at", type=int, default=None)
     p.add_argument("--params-out", default=None)
+    p.add_argument("--service", choices=("reduced", "full"), default=None,
+                   help="run the simulation-service chaos slice "
+                        "(blades_tpu/service): poison/backpressure/"
+                        "deadline/drain drills, plus supervised "
+                        "SIGKILL-resume under 'full'; alone (no --sweep) "
+                        "prints just the slice's JSON line")
     args = p.parse_args()
 
     if args.child:
@@ -617,6 +906,12 @@ def main() -> int:
         # (telemetry/context.py); their Simulator writes the ledger records
         child_main(args)
         return 0
+    if args.service is not None and args.sweep is None:
+        summary = service_chaos(
+            os.path.join(args.out, "service"), full=args.service == "full",
+        )
+        print(json.dumps(summary))
+        return 0 if summary["ok"] else 1
     n = args.sweep if args.sweep is not None else 24
     from blades_tpu.sweeps import program_fingerprint
     from blades_tpu.sweeps.journal import SweepJournal
@@ -667,6 +962,13 @@ def main() -> int:
     finally:
         accounting.close()
         journal.close()
+    if args.service is not None:
+        # the service chaos slice rides the sweep's evidence line: the
+        # committed results/chaos_sweep.json pins both surfaces
+        summary["service"] = service_chaos(
+            os.path.join(args.out, "service"), full=args.service == "full",
+        )
+        summary["ok"] = summary["ok"] and summary["service"]["ok"]
     ledger_entry.ended(
         "finished",
         metrics={

@@ -11,7 +11,10 @@ and, for sweep runs (certify/chaos), a ``sweep_progress`` block (cells
 completed/total, last-cell key + age, ETA) read from the per-cell
 ``sweep`` records in the run's registered trace artifacts
 (``blades_tpu/telemetry/timeline.py``), so a stuck sweep is
-distinguishable from a slow one without reading the raw trace.
+distinguishable from a slow one without reading the raw trace; service
+runs (``blades_tpu/service``) get a ``service_health`` block the same
+way — queue depth, in-flight/served/rejected/quarantined counts,
+oldest-pending age.
 With ``--tunnel`` it additionally summarizes the TPU tunnel probe log
 (``results/tpu_r5/tunnel_probes.jsonl``, written by
 ``scripts/tpu_capture.py``) into availability windows — up fraction,
@@ -96,8 +99,34 @@ def latest_rows(runs: List[Dict[str, Any]], n: int) -> List[Dict[str, Any]]:
     return out
 
 
-def sweep_progress(
+def artifact_records(
     trail: List[Dict[str, Any]], repo: str = REPO
+) -> List[Dict[str, Any]]:
+    """All records from a trail's registered ``.jsonl`` trace artifacts,
+    each file read once (``sweep_progress`` and ``service_health`` both
+    consume this — re-reading multi-MB traces per summarizer would
+    double the query cost on the 1-core box)."""
+    from blades_tpu.telemetry.ledger import read_ledger
+
+    records: List[Dict[str, Any]] = []
+    seen = set()
+    for r in trail:
+        for art in r.get("artifacts") or []:
+            if not isinstance(art, str) or not art.endswith(".jsonl"):
+                continue
+            p = art if os.path.isabs(art) else os.path.join(repo, art)
+            if p in seen or not os.path.exists(p):
+                continue
+            seen.add(p)
+            # read_ledger is the shared torn-line-tolerant JSONL reader —
+            # a live sweep/server may be mid-append
+            records.extend(read_ledger(p))
+    return records
+
+
+def sweep_progress(
+    trail: List[Dict[str, Any]], repo: str = REPO,
+    records: Optional[List[Dict[str, Any]]] = None,
 ) -> Optional[Dict[str, Any]]:
     """Sweep progress for a run's attempt trail, from the per-cell
     ``sweep`` records in its registered trace artifacts
@@ -109,29 +138,18 @@ def sweep_progress(
     trace. ``None`` when the trail has no sweep trace."""
     import time
 
-    from blades_tpu.telemetry.ledger import read_ledger
-
-    paths = []
-    for r in trail:
-        for art in r.get("artifacts") or []:
-            if not isinstance(art, str) or not art.endswith(".jsonl"):
-                continue
-            p = art if os.path.isabs(art) else os.path.join(repo, art)
-            if p not in paths and os.path.exists(p):
-                paths.append(p)
+    if records is None:
+        records = artifact_records(trail, repo)
     cells: List[Dict[str, Any]] = []
     resilient: List[Dict[str, Any]] = []
-    for p in paths:
-        # read_ledger is the shared torn-line-tolerant JSONL reader — a
-        # live sweep may be mid-append
-        for r in read_ledger(p):
-            if r.get("t") == "sweep":
-                cells.append(r)
-            elif r.get("t") in ("retry", "quarantine", "resume"):
-                # resilient-execution trail (blades_tpu/sweeps/
-                # resilient.py): a resumed or degraded sweep must be
-                # distinguishable from a clean one here too
-                resilient.append(r)
+    for r in records:
+        if r.get("t") == "sweep":
+            cells.append(r)
+        elif r.get("t") in ("retry", "quarantine", "resume"):
+            # resilient-execution trail (blades_tpu/sweeps/
+            # resilient.py): a resumed or degraded sweep must be
+            # distinguishable from a clean one here too
+            resilient.append(r)
     # DRIVER cells only: the SweepAccounting owner stamps the i-of-N
     # progress marker; library-level sub-cells sharing the trace (the
     # `attack_search` family certify's cells contain) carry no `i` —
@@ -197,6 +215,25 @@ def sweep_progress(
     if eta is not None:
         out["eta_s"] = eta
     return out
+
+
+def service_health(
+    trail: List[Dict[str, Any]], repo: str = REPO,
+    records: Optional[List[Dict[str, Any]]] = None,
+) -> Optional[Dict[str, Any]]:
+    """Service health for a ``service`` run's attempt trail, from the
+    ``service``/``request`` records in its registered trace artifacts
+    (``blades_tpu/service`` registers ``service_trace.jsonl`` on its
+    STARTED ledger record, so a LIVE server is queryable). Same rollup as
+    ``sweep_status.summarize_service`` — queue depth, in-flight,
+    served/rejected/quarantined, oldest-pending age. ``None`` when the
+    trail has no service records."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from sweep_status import summarize_service
+
+    if records is None:
+        records = artifact_records(trail, repo)
+    return summarize_service(records)
 
 
 def summarize_tunnel(records: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -306,10 +343,18 @@ def _run(argv: Optional[List[str]] = None) -> int:
         ]
         payload["found"] = bool(trail)
         # sweep runs: cells completed/total + last-cell age from the
-        # per-cell sweep records in the trail's registered trace artifacts
-        progress = sweep_progress(trail)
+        # per-cell sweep records in the trail's registered trace
+        # artifacts (read once, shared by both summarizers)
+        records_art = artifact_records(trail)
+        progress = sweep_progress(trail, records=records_art)
         if progress is not None:
             payload["sweep_progress"] = progress
+        # service runs (blades_tpu/service): queue depth, in-flight,
+        # served/rejected/quarantined, oldest-pending age — a wedged
+        # server is distinguishable from a busy one from the ledger alone
+        health = service_health(trail, records=records_art)
+        if health is not None:
+            payload["service_health"] = health
     else:
         payload["latest"] = latest_rows(paired, args.latest)
 

@@ -1,0 +1,183 @@
+"""Simulation-service CLI: start / submit / status / result / drain.
+
+The driver-facing face of ``blades_tpu/service`` (docs/robustness.md
+"Simulation service"): every subcommand prints exactly ONE JSON line
+(the ``bench.py`` contract), so a harness can script the full lifecycle
+without parsing logs.
+
+Usage::
+
+    # the long-lived server (blocks until drained; exit 0 on a clean
+    # drain). Run it supervised for the full crash story:
+    python -m blades_tpu.supervision --heartbeat-timeout 300 -- \\
+        python scripts/serve.py start --out results/service_run
+
+    python scripts/serve.py submit --socket S --request '{"kind": ...}'
+    python scripts/serve.py submit --socket S --request @req.json --no-wait
+    python scripts/serve.py result --socket S --id req-... [--wait 120]
+    python scripts/serve.py status --socket S
+    python scripts/serve.py drain  --socket S
+
+``start`` honors ``BLADES_RESUME=1`` (what the supervisor exports on
+relaunch): the spool's pending requests re-queue and execute only their
+unjournaled cells. ``--devices N`` sets the virtual-CPU mesh the first
+``simulate`` cell initializes jax with (probe-only servers never import
+jax at all).
+
+Reference counterpart: none — the reference has no serving surface
+(``src/blades/simulator.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+METRIC = "service"
+
+
+def _load_request(raw: str) -> dict:
+    if raw.startswith("@"):
+        with open(raw[1:]) as fh:
+            raw = fh.read()
+    req = json.loads(raw)
+    if not isinstance(req, dict):
+        raise ValueError("request must be a JSON object")
+    return req
+
+
+def _start(args) -> int:
+    from blades_tpu.service.handlers import DEVICES_ENV
+    from blades_tpu.service.server import SimulationService
+    from blades_tpu.telemetry import context as _context
+
+    _context.activate(fresh=True)
+    if args.devices is not None:
+        os.environ[DEVICES_ENV] = str(args.devices)
+    svc = SimulationService(
+        args.out,
+        socket_path=args.socket,
+        max_queue=args.max_queue,
+        attempts=args.attempts,
+        base_delay_s=args.base_delay,
+        cell_deadline_s=args.cell_deadline,
+        health_interval_s=args.health_interval,
+    )
+    snap = svc.serve()
+    print(json.dumps({
+        "metric": METRIC,
+        "out": args.out,
+        "socket": svc.socket_path,
+        "resumed_start": svc.resume,
+        **{k: v for k, v in snap.items() if k != "pid"},
+        "ok": True,
+    }))
+    return 0
+
+
+def _client(args):
+    from blades_tpu.service.client import ServiceClient
+
+    return ServiceClient(args.socket, timeout=args.timeout)
+
+
+def _submit(args) -> int:
+    request = _load_request(args.request)
+    if args.id:
+        request["id"] = args.id
+    reply = _client(args).submit(request, wait=not args.no_wait)
+    print(json.dumps({"metric": f"{METRIC}_submit", **reply}))
+    return 0 if reply.get("ok") else 1
+
+
+def _result(args) -> int:
+    client = _client(args)
+    if args.wait:
+        reply = client.wait_result(args.id, timeout=args.wait)
+    else:
+        reply = client.result(args.id)
+    print(json.dumps({"metric": f"{METRIC}_result", **reply}))
+    return 0 if reply.get("ok") and reply.get("status") == "done" else 1
+
+
+def _status(args) -> int:
+    reply = _client(args).status()
+    print(json.dumps({"metric": f"{METRIC}_status", **reply}))
+    return 0 if reply.get("ok") else 1
+
+
+def _drain(args) -> int:
+    reply = _client(args).drain()
+    print(json.dumps({"metric": f"{METRIC}_drain", **reply}))
+    return 0 if reply.get("ok") else 1
+
+
+def _run(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("start", help="run the server until drained")
+    ps.add_argument("--out", default=os.path.join(REPO, "results", "service_run"))
+    ps.add_argument("--socket", default=None,
+                    help="socket path (default <out>/service.sock)")
+    ps.add_argument("--max-queue", type=int, default=8)
+    ps.add_argument("--attempts", type=int, default=2,
+                    help="per-cell retry budget (resilient ladder)")
+    ps.add_argument("--cell-deadline", type=float, default=None,
+                    help="per-cell soft deadline (s); the request deadline "
+                         "is this x its cell count")
+    ps.add_argument("--base-delay", type=float, default=0.5)
+    ps.add_argument("--health-interval", type=float, default=30.0)
+    ps.add_argument("--devices", type=int, default=1,
+                    help="virtual-CPU device count for simulate cells")
+    ps.set_defaults(func=_start)
+
+    for name, func, extra in (
+        ("submit", _submit, "request"),
+        ("result", _result, "id"),
+        ("status", _status, None),
+        ("drain", _drain, None),
+    ):
+        pc = sub.add_parser(name)
+        pc.add_argument("--socket", required=True)
+        pc.add_argument("--timeout", type=float, default=120.0)
+        if extra == "request":
+            pc.add_argument("--request", required=True,
+                            help="request JSON (or @file)")
+            pc.add_argument("--id", default=None)
+            pc.add_argument("--no-wait", action="store_true")
+        elif extra == "id":
+            pc.add_argument("--id", required=True)
+            pc.add_argument("--wait", type=float, default=None,
+                            help="poll until done for up to this many s")
+        pc.set_defaults(func=func)
+
+    args = p.parse_args(argv)
+    return args.func(args)
+
+
+def main(argv: Optional[list] = None) -> int:
+    """One-JSON-line contract, unconditionally (the ``bench.py``
+    discipline): even a bug in the service CLI must reach the driver as
+    a single parseable error line, never a traceback-only death."""
+    try:
+        return _run(argv)
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 - the contract IS the catch-all
+        print(json.dumps({
+            "metric": METRIC,
+            "ok": False,
+            "error": f"{type(e).__name__}: {e}"[:1000],
+        }))
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,10 +13,17 @@ a growing ``last_cell_age_s`` with ``cells`` frozen, a slow one shows
 cells advancing — distinguishable without reading the raw trace
 (the same trail ``scripts/runs.py --run-id`` reports from the ledger).
 
+Simulation-service traces (``blades_tpu/service`` —
+``<out>/service_trace.jsonl``) get an additional ``service`` block:
+queue depth, in-flight, served/rejected/quarantined counts,
+oldest-pending age — a wedged server (pending aging, cells frozen) is
+distinguishable from a busy one and from an idle one.
+
 Usage::
 
     python scripts/sweep_status.py results/certification/sweep_trace.jsonl
     python scripts/sweep_status.py <dir>     # finds <dir>/sweep_trace.jsonl
+                                             # (or service_trace.jsonl)
 
 Stdlib-only, no jax import — runs on any host while the sweep runs.
 Reference counterpart: none — the reference has no sweeps and no
@@ -134,7 +141,11 @@ def summarize_sweeps(
             row["cells_per_program"] = (
                 round(done / programs, 2) if programs else None
             )
-        if fam["total"] is not None:
+        # the service family's i/total are scoped PER REQUEST (reset for
+        # each one), so a cross-request max-i "progress" would be
+        # nonsense (frac > 1 after two requests); request progress lives
+        # in the `service` block instead
+        if fam["total"] is not None and name != "service":
             row["total"] = fam["total"]
             # progress from the max i-of-N stamp, not the record count: a
             # resumed trace carries the interrupted attempt's records PLUS
@@ -171,10 +182,83 @@ def summarize_sweeps(
     return summary
 
 
+def summarize_service(
+    records: List[Dict[str, Any]], now: Optional[float] = None
+) -> Optional[Dict[str, Any]]:
+    """Service health from ``service``/``request`` records
+    (``blades_tpu/service``): queue depth, in-flight, cumulative
+    served/rejected/quarantined counts, oldest-pending age — so a WEDGED
+    server (pending requests aging, no cell progress) is distinguishable
+    from a busy one (cells advancing in the ``sweeps`` block) and from an
+    idle one (zero pending, recent health record). ``None`` when the
+    trace carries no service records."""
+    now = time.time() if now is None else now
+    svc = [r for r in records if r.get("t") == "service"]
+    reqs = [r for r in records if r.get("t") == "request"]
+    if not svc and not reqs:
+        return None
+    out: Dict[str, Any] = {}
+    # the LAST full snapshot record stands, as a unit (`health`/`exit`
+    # records carry `served`): scanning per-field across older records
+    # would resurrect stale values — e.g. an oldest_pending_age_s from a
+    # busy moment reported forever on an idle server, corrupting exactly
+    # the wedged-vs-idle signal this block exists for
+    snap = next((r for r in reversed(svc) if "served" in r), None)
+    if snap is not None:
+        for field in ("queue_depth", "in_flight", "served", "rejected",
+                      "quarantined_requests", "oldest_pending_age_s",
+                      "draining", "uptime_s"):
+            if field in snap:
+                out[field] = snap[field]
+    last_ts = max(
+        (r["ts"] for r in svc + reqs if isinstance(r.get("ts"), (int, float))),
+        default=None,
+    )
+    if last_ts is not None:
+        out["last_event_ts"] = last_ts
+        out["last_event_age_s"] = round(now - last_ts, 1)
+    # request lifecycle rollup: admitted-without-finished ARE the pending
+    # set (survives a server that died before its next health record)
+    admitted: Dict[str, float] = {}
+    finished: Dict[str, str] = {}
+    for r in reqs:
+        rid = r.get("id")
+        if not rid:
+            continue
+        if r.get("event") == "admitted":
+            admitted[rid] = r.get("ts")
+        elif r.get("event") == "finished":
+            finished[rid] = r.get("outcome", "ok")
+    pending = {
+        rid: ts for rid, ts in admitted.items() if rid not in finished
+    }
+    by_outcome: Dict[str, int] = {}
+    for outcome in finished.values():
+        by_outcome[outcome] = by_outcome.get(outcome, 0) + 1
+    out["requests"] = {
+        "admitted": len(admitted),
+        "finished": len(finished),
+        "pending": len(pending),
+        **({"by_outcome": by_outcome} if by_outcome else {}),
+    }
+    pending_ts = [ts for ts in pending.values() if ts is not None]
+    if pending_ts and "oldest_pending_age_s" not in out:
+        out["oldest_pending_age_s"] = round(now - min(pending_ts), 1)
+    resumes = [r for r in svc if r.get("event") == "start" and r.get("resumed")]
+    if resumes:
+        out["resumed_requests"] = resumes[-1]["resumed"]
+    return out
+
+
 def resolve_trace(target: str) -> str:
-    """A trace path, or a directory containing ``sweep_trace.jsonl``."""
+    """A trace path, or a directory containing ``sweep_trace.jsonl`` (a
+    sweep driver's) or ``service_trace.jsonl`` (a simulation service's)."""
     if os.path.isdir(target):
-        return os.path.join(target, "sweep_trace.jsonl")
+        sweep = os.path.join(target, "sweep_trace.jsonl")
+        service = os.path.join(target, "service_trace.jsonl")
+        if not os.path.exists(sweep) and os.path.exists(service):
+            return service
+        return sweep
     return target
 
 
@@ -193,6 +277,9 @@ def _run(argv: Optional[List[str]] = None) -> int:
     records = load_sweep_records(path)
     summary = summarize_sweeps(records)
     payload = {"metric": METRIC, "trace": path, **summary, "ok": True}
+    service = summarize_service(records)
+    if service is not None:
+        payload["service"] = service
     print(json.dumps(payload))
     return 0
 

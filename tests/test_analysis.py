@@ -365,6 +365,88 @@ def test_schema001_sees_timeline_and_sweep_emitters_on_head():
     assert {"timeline", "sweep"} <= emitted, sorted(emitted)
 
 
+def test_imp001_covers_service_modules(tmp_path):
+    """PR 14 surface: the simulation-service package (`blades_tpu/
+    service/` — client, protocol, spool, server, __init__) entered the
+    pre-jax contract set: clients submit from hosts where the tunnel is
+    down and a probe-only server must start jax-free. A module-scope jax
+    import in any of them must fire IMP001 (fire direction; HEAD silence
+    is test_tier_a_silent_on_head, runtime side is
+    test_import_service_before_jax)."""
+    svc = tmp_path / "blades_tpu" / "service"
+    svc.mkdir(parents=True)
+    for name in ("__init__", "protocol", "client", "spool", "server"):
+        (svc / f"{name}.py").write_text(
+            '"""Doc. Reference counterpart: none — test module."""\n'
+            "import jax\n"
+        )
+    violations, _ = run_rules(RepoIndex(str(tmp_path)), all_rules())
+    assert sorted(v.path for v in violations if v.rule == "IMP001") == [
+        "blades_tpu/service/__init__.py",
+        "blades_tpu/service/client.py",
+        "blades_tpu/service/protocol.py",
+        "blades_tpu/service/server.py",
+        "blades_tpu/service/spool.py",
+    ], [str(v) for v in violations]
+
+
+def test_json001_covers_serve_script(tmp_path):
+    """PR 14 surface: `scripts/serve.py` (the service CLI) entered the
+    one-JSON-line contract set — a main() without the catch-all funnel
+    must fire JSON001 (runtime side:
+    tests/test_service.py::test_serve_cli_one_json_line_on_error)."""
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "serve.py").write_text(textwrap.dedent(
+        '''\
+        """Doc. Reference counterpart: none — test module."""
+        import json
+
+
+        def main():
+            print(json.dumps({"ok": True}))  # no try/except catch-all
+        '''
+    ))
+    violations, _ = run_rules(RepoIndex(str(tmp_path)), all_rules())
+    assert [v.rule for v in violations] == ["JSON001"], [
+        str(v) for v in violations
+    ]
+
+
+def test_schema001_sees_service_emitters(tmp_path):
+    """PR 14 surface, both directions: the static emit scan SEES the
+    service/request emitters on HEAD (declaration can't outlive its
+    emitters), and an undeclared service-record emit in a fixture tree
+    fires SCHEMA001 (a new record type cannot land without moving the
+    schema)."""
+    from blades_tpu.analysis.rules.schema_drift import emitted_types
+
+    emitted = {t for t, _, _ in emitted_types(RepoIndex(REPO))}
+    assert {"service", "request"} <= emitted, sorted(emitted)
+
+    svc = tmp_path / "blades_tpu" / "service"
+    svc.mkdir(parents=True)
+    (svc / "server.py").write_text(textwrap.dedent(
+        '''\
+        """Doc. Reference counterpart: none — test module."""
+
+
+        def emit(rec):
+            rec.event("service", event="health")
+        '''
+    ))
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "telemetry_schema.json").write_text(
+        json.dumps({"types": {"meta": {}}})
+    )
+    violations, _ = run_rules(RepoIndex(str(tmp_path)), all_rules())
+    hits = [v for v in violations if v.rule == "SCHEMA001"]
+    assert len(hits) == 1 and "'service'" in hits[0].message, [
+        str(v) for v in violations
+    ]
+
+
 def test_alias001_catches_with_statement_load(tmp_path):
     """Regression (review finding): `with np.load(path) as z:` is the
     documented numpy idiom for NpzFile and must taint the bound archive
@@ -578,6 +660,18 @@ def test_import_timeline_before_jax():
     importable (and its sweep-status consumer runnable) before jax —
     sweep progress is queried from hosts where the tunnel is down."""
     proc = _import_probe("import blades_tpu.telemetry.timeline")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_import_service_before_jax():
+    """PR 14 contract: the simulation-service package — and the server
+    module itself — must be importable (and a probe-only request loop
+    runnable) without jax entering the process; the jax-importing
+    simulate handler stays behind function-scope imports."""
+    proc = _import_probe(
+        "import blades_tpu.service, blades_tpu.service.server, "
+        "blades_tpu.service.handlers"
+    )
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 

@@ -134,6 +134,19 @@ def test_full_sweep_zero_violations(tmp_path):
 
 
 @pytest.mark.slow
+def test_service_chaos_full_slice(tmp_path):
+    """The full simulation-service chaos slice (reduced slice runs
+    tier-1 in tests/test_service.py): poison isolation, backpressure,
+    deadline-tripped hang, drain-no-loss, plus the supervised
+    SIGKILL-resume drill — the committed evidence run behind
+    results/chaos_sweep.json's `service` block."""
+    summary = chaos.service_chaos(str(tmp_path), full=True)
+    assert summary["ok"], json.dumps(summary, indent=1)
+    names = [s["name"] for s in summary["scenarios"]]
+    assert "sigkill_resume" in names and len(names) == 5
+
+
+@pytest.mark.slow
 def test_supervised_sigkill_resume_bit_exact(tmp_path):
     """A chaos child SIGKILLs itself (no autosave, no cleanup — the
     hardest crash) at round 2; the supervisor relaunches with

@@ -1,0 +1,442 @@
+"""Simulation service (`blades_tpu/service`, `scripts/serve.py`): the
+long-lived crash-tolerant experiment server — spool/journal durability
+under concurrent writers, request-level fault isolation (poison
+quarantine with sibling+neighbor salvage, deadline-tripped hangs,
+backpressure), drain-with-zero-loss, the supervised SIGKILL → resume →
+content-identical e2e, warm-cache serving with a zero-new-compiles pin,
+and the health surfaces (`sweep_status`, `runs.py`) + perf-gate guard.
+
+Probe-request scenarios run against REAL server subprocesses and never
+import jax (the server is up in ~1s), so the tier-1 slice stays cheap;
+the one jitted-path test (`test_warm_serving_zero_compiles`) uses a
+minimal 1-cell simulate request in-process.
+
+Reference counterpart: none — the reference runs one configuration per
+cold process and has no serving surface (`src/blades/simulator.py`).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from blades_tpu.service.client import ServiceClient, ServiceError  # noqa: E402
+from blades_tpu.service.protocol import (  # noqa: E402
+    mint_request_id,
+    socket_path_for,
+)
+from blades_tpu.service.spool import RequestSpool  # noqa: E402
+from blades_tpu.telemetry.schema import validate_trace  # noqa: E402
+
+CHAOS = os.path.join(REPO, "scripts", "chaos.py")
+SERVE = os.path.join(REPO, "scripts", "serve.py")
+
+_spec = importlib.util.spec_from_file_location("chaos_for_service", CHAOS)
+chaos = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(chaos)
+
+
+def _start(tmp_path, name, *extra, env=None):
+    out = str(tmp_path / name)
+    e = dict(os.environ, BLADES_LEDGER=str(tmp_path / f"{name}_ledger.jsonl"))
+    e.update(env or {})
+    proc = subprocess.Popen(
+        [sys.executable, SERVE, "start", "--out", out,
+         "--base-delay", "0.05", *extra],
+        env=e, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    client = ServiceClient(
+        socket_path_for(out), timeout=60,
+        connect_retries=50, connect_delay_s=0.2,
+    )
+    return out, proc, client
+
+
+def _finish(proc, client):
+    try:
+        if proc.poll() is None:
+            client.drain()
+    except ServiceError:
+        pass
+    out, err = proc.communicate(timeout=60)
+    return proc.returncode, out, err
+
+
+# -- spool --------------------------------------------------------------------
+
+
+def test_spool_roundtrip_pending_and_fresh_truncation(tmp_path):
+    path = str(tmp_path / "spool.jsonl")
+    s = RequestSpool(path)
+    r1 = s.admit({"kind": "probe", "cells": [{"op": "ok"}]})
+    r2 = s.admit({"kind": "probe", "cells": [{"op": "ok"}]}, request_id="my-id")
+    assert r2 == "my-id"
+    s.complete(r1, {"ok": True, "id": r1})
+    s.close()
+
+    # resume recovers: r1 done (reply fetchable), r2 pending in order
+    r = RequestSpool(path, resume=True)
+    assert r.resumed
+    assert r.reply(r1) == {"ok": True, "id": r1}
+    assert r.reply(r2) is None
+    assert [rid for rid, _ in r.pending()] == [r2]
+    assert r.counts() == {"admitted": 2, "done": 1, "pending": 1}
+    r.close()
+
+    # a fresh (non-resume) start truncates: old requests belong to the
+    # previous service lifetime
+    f = RequestSpool(path)
+    assert not f.resumed and not f.has(r1) and len(f) == 0
+    f.close()
+
+
+def test_spool_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "spool.jsonl")
+    s = RequestSpool(path)
+    rid = s.admit({"kind": "probe", "cells": [{"op": "ok"}]})
+    s.close()
+    with open(path, "a") as f:
+        f.write('{"kind": "done", "id": "x", "reply": {"tr')  # torn
+    r = RequestSpool(path, resume=True)
+    assert r.resumed and r.has(rid) and r.reply("x") is None
+    r.close()
+
+
+# -- concurrent-append safety (journal + ledger) -------------------------------
+
+# a record payload comfortably larger than the default stdio buffer:
+# a buffered writer WOULD split it across write(2) calls, so two
+# concurrent writers interleaving would tear neighbors' lines — the
+# O_APPEND single-write discipline must keep every line whole
+_BIG = 9000
+
+
+def _parse_all_lines(path):
+    whole, torn = [], 0
+    with open(path) as fh:
+        for line in fh:
+            try:
+                whole.append(json.loads(line))
+            except ValueError:
+                torn += 1
+    return whole, torn
+
+
+def test_interleaved_journal_writers(tmp_path):
+    """Two processes appending large cells to ONE journal concurrently:
+    every line stays whole (no interleaved/torn lines), every record
+    lands."""
+    path = str(tmp_path / "j.jsonl")
+    from blades_tpu.sweeps.journal import SweepJournal
+
+    SweepJournal(path, fingerprint="fp").close()  # meta line, then writers
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from blades_tpu.sweeps.journal import SweepJournal\n"
+        "j = SweepJournal(%r, fingerprint='fp', resume=True)\n"
+        "for i in range(40):\n"
+        "    j.record('%%s-%%03d' %% (sys.argv[1], i), {'pad': 'x' * %d})\n"
+        "j.close()\n"
+    ) % (REPO, path, _BIG)
+    procs = [
+        subprocess.Popen([sys.executable, "-c", code, tag], cwd=REPO)
+        for tag in ("a", "b")
+    ]
+    for p in procs:
+        assert p.wait(timeout=120) == 0
+    records, torn = _parse_all_lines(path)
+    assert torn == 0
+    cells = {r["cell"] for r in records if r.get("kind") == "cell"}
+    assert len(cells) == 80
+    assert all(len(r.get("result", {}).get("pad", "")) == _BIG
+               for r in records if r.get("kind") == "cell")
+
+
+def test_interleaved_ledger_writers(tmp_path):
+    """Two processes appending large ledger records concurrently: no torn
+    lines, all records land (the supervisor-vs-child and service-vs-
+    supervisor append races)."""
+    path = str(tmp_path / "ledger.jsonl")
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from blades_tpu.telemetry import ledger\n"
+        "for i in range(40):\n"
+        "    ledger.record_event('race', 'killed', run_id='%%s-%%03d'\n"
+        "                        %% (sys.argv[1], i), path=%r,\n"
+        "                        error='x' * %d)\n"
+    ) % (REPO, path, _BIG)
+    procs = [
+        subprocess.Popen([sys.executable, "-c", code, tag], cwd=REPO)
+        for tag in ("a", "b")
+    ]
+    for p in procs:
+        assert p.wait(timeout=120) == 0
+    records, torn = _parse_all_lines(path)
+    assert torn == 0
+    assert len({r["run_id"] for r in records}) == 80
+
+
+# -- request-level fault isolation (real server subprocesses, probe-only) ------
+
+
+def test_service_chaos_reduced(tmp_path):
+    """The reduced chaos service slice against real servers: poison
+    request quarantined (attributable error) while siblings and a
+    concurrent request complete; backpressure rejects with an explicit
+    reply; a hung cell trips the deadline without wedging the server;
+    drain exits 0 with zero lost requests."""
+    summary = chaos.service_chaos(str(tmp_path), full=False)
+    assert summary["ok"], json.dumps(summary, indent=1)
+    assert [s["name"] for s in summary["scenarios"]] == [
+        "poison_isolated", "backpressure", "deadline_hang", "drain_no_loss",
+    ]
+
+
+def test_sigkill_resume_content_identical(tmp_path):
+    """The acceptance e2e: SIGKILL the supervised server mid-request (the
+    journal saboteur fires after the 2nd journaled cell), relaunch under
+    BLADES_RESUME=1 replays the spool, executes ONLY the remaining
+    cells, and the client-visible reply is content-identical to an
+    uninterrupted run's."""
+    row = chaos._scn_sigkill_resume(str(tmp_path))
+    assert row["ok"], json.dumps(row)
+    assert row["supervisor_rc"] == 0
+    assert row["content_identical"]
+    assert row["resumed_skipped"] == 2  # the 2 journaled cells, recovered
+    assert row["executed"] == 2         # ONLY the remainder ran
+
+
+def test_idempotent_resubmit_served_from_spool(tmp_path):
+    """Submitting a completed request id again returns the spooled reply
+    without re-executing (and a fresh id does execute)."""
+    out, proc, client = _start(tmp_path, "svc")
+    try:
+        rid = mint_request_id()
+        req = {"kind": "probe", "cells": [{"label": "a", "op": "ok",
+                                           "value": 5}]}
+        first = client.submit(req, request_id=rid)
+        again = client.submit(req, request_id=rid)
+        assert again["served"] == "spool"
+        assert again["reply"]["cells"] == first["cells"]
+        status = client.status()
+        assert status["served"] == 1  # the resubmit executed nothing
+    finally:
+        rc, _, _ = _finish(proc, client)
+    assert rc == 0
+
+
+def test_trace_schema_and_health_surfaces(tmp_path):
+    """One served+one quarantined request: the service trace validates
+    against the committed schema, `sweep_status` reports the service
+    block, and `runs.py --run-id` reports service_health from the
+    ledger's registered artifacts."""
+    out, proc, client = _start(tmp_path, "svc")
+    ledger = str(tmp_path / "svc_ledger.jsonl")
+    try:
+        client.submit({"kind": "probe",
+                       "cells": [{"label": "a", "op": "ok"}]})
+        client.submit({"kind": "probe",
+                       "cells": [{"label": "b", "op": "fail"}]})
+        run_id = client.ping()["run_id"]
+    finally:
+        rc, _, _ = _finish(proc, client)
+    assert rc == 0
+
+    trace = os.path.join(out, "service_trace.jsonl")
+    assert validate_trace(trace) == []
+
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "sweep_status.py"),
+         out],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    payload = json.loads(p.stdout)
+    assert payload["ok"] and p.returncode == 0
+    svc = payload["service"]
+    assert svc["served"] == 2 and svc["quarantined_requests"] == 1
+    assert svc["requests"]["admitted"] == 2
+    assert svc["requests"]["pending"] == 0
+    assert svc["requests"]["by_outcome"] == {"ok": 1, "quarantined": 1}
+    # the per-cell accounting rides ordinary sweep records
+    assert payload["sweeps"]["service"]["cells"] == 2
+
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "runs.py"),
+         "--run-id", run_id, "--ledger", ledger],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    payload = json.loads(p.stdout)
+    assert payload["ok"] and payload["found"]
+    health = payload["service_health"]
+    assert health["served"] == 2
+    assert health["requests"]["finished"] == 2
+    # per-request ledger entries under the inherited run id
+    kinds = {r["kind"] for r in payload["attempts"]}
+    assert {"service", "request"} <= kinds
+
+
+def test_unsafe_request_ids_and_labels_rejected(tmp_path):
+    """Request ids and cell labels become filesystem path segments (the
+    per-request journal dir, each simulate cell's log dir — which the
+    Simulator WIPES at construction), so a '/'-carrying or absolute
+    value must be rejected at the door, never spooled or executed."""
+    from blades_tpu.service.handlers import build_cells, safe_name
+
+    for bad in ("/root/repo/results", "../escape", "a/b", "", ".hidden"):
+        with pytest.raises(ValueError):
+            safe_name(bad, "request id")
+        with pytest.raises(ValueError):
+            build_cells({"kind": "probe", "cells": [{"label": bad or "x/y",
+                                                     "op": "ok"}]})
+    assert safe_name("req-20260805T0-abc123", "request id")
+
+    out, proc, client = _start(tmp_path, "svc")
+    try:
+        reply = client.submit(
+            {"kind": "probe", "cells": [{"label": "a", "op": "ok"}]},
+            request_id="../../escape",
+        )
+        assert reply["ok"] is False and "safe name" in reply["error"]
+        # never admitted: nothing spooled, nothing executed
+        assert client.status()["served"] == 0
+        assert not (tmp_path / "escape").exists()
+    finally:
+        rc, _, _ = _finish(proc, client)
+    assert rc == 0
+
+
+def test_summarize_service_no_stale_pending_age():
+    """An idle server whose LATEST health snapshot omits
+    oldest_pending_age_s must not resurrect the value from an older,
+    busier snapshot (per-field last-wins would) — the wedged-vs-idle
+    signal depends on it."""
+    import sweep_status
+
+    records = [
+        {"t": "service", "event": "health", "ts": 100.0, "served": 1,
+         "queue_depth": 1, "oldest_pending_age_s": 42.0},
+        {"t": "request", "event": "admitted", "id": "r1", "ts": 90.0},
+        {"t": "request", "event": "finished", "id": "r1", "ts": 101.0,
+         "outcome": "ok"},
+        {"t": "service", "event": "health", "ts": 110.0, "served": 2,
+         "queue_depth": 0},
+    ]
+    out = sweep_status.summarize_service(records, now=120.0)
+    assert "oldest_pending_age_s" not in out
+    assert out["served"] == 2 and out["queue_depth"] == 0
+    assert out["requests"]["pending"] == 0
+
+
+def test_summarize_service_pending_age_and_wedge_signal():
+    """A wedged server — admitted request, no finish, stale records —
+    surfaces a growing oldest-pending age from the request trail alone
+    (no health record needed)."""
+    import sweep_status
+
+    now = 1000.0
+    records = [
+        {"t": "service", "event": "start", "ts": 900.0, "queue_depth": 0},
+        {"t": "request", "event": "admitted", "id": "r1", "ts": 940.0},
+        {"t": "request", "event": "started", "id": "r1", "ts": 941.0},
+    ]
+    out = sweep_status.summarize_service(records, now=now)
+    assert out["requests"] == {"admitted": 1, "finished": 0, "pending": 1}
+    assert out["oldest_pending_age_s"] == 60.0
+    assert out["last_event_age_s"] == 59.0
+    assert sweep_status.summarize_service(
+        [{"t": "sweep", "sweep": "certify", "cell": "x", "wall_s": 1.0}]
+    ) is None
+
+
+def test_serve_cli_one_json_line_on_error(tmp_path):
+    """The JSON001 contract end-to-end: an unreachable socket still
+    yields exactly one parseable error line, rc != 0."""
+    p = subprocess.run(
+        [sys.executable, SERVE, "status",
+         "--socket", str(tmp_path / "nope.sock"), "--timeout", "5"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    lines = [l for l in p.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1 and p.returncode != 0
+    payload = json.loads(lines[0])
+    assert payload["ok"] is False and "error" in payload
+
+
+# -- warm serving (the one jitted-path test) -----------------------------------
+
+
+def test_warm_serving_zero_compiles(tmp_path):
+    """A repeated identical simulate request is served entirely from the
+    warm EngineCache/dataset caches: zero new XLA compiles (the
+    perf-gate pin, in-process form) and bit-identical results."""
+    from blades_tpu.service.server import SimulationService
+    from blades_tpu.telemetry import recorder as _trec
+
+    svc = SimulationService(str(tmp_path / "svc"))
+    req = {"kind": "simulate", "cells": [
+        {"label": "m", "agg": "mean", "rounds": 1, "seed": 3,
+         "train_size": 64, "test_size": 32},
+    ]}
+    first = svc._execute("r1", req)
+    assert first["ok"], first
+    before = _trec.process_counters()
+    second = svc._execute("r2", req)
+    delta = _trec.process_counters().get("xla.compiles", 0) - before.get(
+        "xla.compiles", 0)
+    assert delta == 0
+    assert second["cells"] == first["cells"]
+    assert svc._engine_cache.stats()["hits"] >= 1
+
+
+# -- perf-gate guard (fire + pass directions) ----------------------------------
+
+
+def test_check_warm_serving_directions():
+    import perf_report
+
+    thresholds = dict(perf_report.DEFAULT_THRESHOLDS)
+    baseline = {
+        "derived": {"service_warm_cell_s": 0.06},
+        "rows": {"dispatch/cert_slice_batched": {
+            "per_cell_overhead_s": 0.10}},
+    }
+    good = {"warm_compiles": 0, "warm_per_cell_overhead_s": 0.001,
+            "warm_mean_cell_s": 0.06}
+    assert perf_report.check_warm_serving(good, baseline, thresholds) == []
+
+    # fire: compiles crept back in / overhead above the batched baseline /
+    # per-cell wall grew past threshold / evidence missing
+    bad = {"warm_compiles": 3, "warm_per_cell_overhead_s": 0.2,
+           "warm_mean_cell_s": 0.2}
+    msgs = perf_report.check_warm_serving(bad, baseline, thresholds)
+    assert len(msgs) == 3
+    assert any("XLA compiles" in m for m in msgs)
+    assert any("batched-sweep baseline" in m for m in msgs)
+    assert any("warm_mean_cell_s" in m for m in msgs)
+    missing = perf_report.check_warm_serving(None, baseline, thresholds)
+    assert missing and "evidence missing" in missing[0]
+    # dormant before the baseline records the claim
+    assert perf_report.check_warm_serving(bad, {"derived": {}},
+                                          thresholds) == []
+
+
+def test_committed_warm_serving_evidence_passes_gate():
+    """The committed measurement (results/service/warm_serving.json) must
+    satisfy the armed guard against the committed baseline."""
+    import perf_report
+
+    stats = perf_report.service_warm_stats(REPO)
+    assert stats is not None and stats["ok"]
+    baseline = json.load(open(
+        os.path.join(REPO, "results", "perf_report", "baseline.json")))
+    thresholds = dict(perf_report.DEFAULT_THRESHOLDS)
+    thresholds.update(baseline.get("thresholds") or {})
+    assert perf_report.check_warm_serving(stats, baseline, thresholds) == []
+    assert baseline["derived"]["service_warm_cell_s"] == stats[
+        "warm_mean_cell_s"]
