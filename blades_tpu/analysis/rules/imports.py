@@ -39,6 +39,10 @@ NO_JAX_SUFFIXES = (
     "blades_tpu/telemetry/ledger.py",
     "blades_tpu/telemetry/alerts.py",
     "blades_tpu/telemetry/timeline.py",
+    # request-path accounting (PR 15): the serving-path metrics layer is
+    # consumed by the probe-only server and every status/metrics query
+    # surface — all of which must run with the tunnel down, jax-free
+    "blades_tpu/telemetry/reqpath.py",
     "blades_tpu/supervision/__init__.py",
     "blades_tpu/supervision/__main__.py",
     "blades_tpu/supervision/heartbeat.py",

@@ -110,6 +110,13 @@ class ServiceClient:
     def status(self) -> Dict[str, Any]:
         return self.request({"op": "status"})
 
+    def metrics(self) -> Dict[str, Any]:
+        """The rolling serving-metrics snapshot (``telemetry/reqpath.py``):
+        latency histograms with p50/p90/p99 (total/warm/cold),
+        queue-wait share, per-op and per-client counters, queue-depth
+        high-water mark."""
+        return self.request({"op": "metrics"})
+
     def drain(self) -> Dict[str, Any]:
         """Ask the server to finish everything admitted and exit 0."""
         return self.request({"op": "drain"})

@@ -18,17 +18,25 @@ Client -> server messages carry an ``op``:
   spool (``status``: ``done`` / ``pending`` / ``unknown``). This is the
   crash-recovery path: a client whose ``submit`` connection died with a
   SIGKILLed server polls ``result`` against the relaunched one.
-- ``{"op": "status"}`` — health snapshot (queue depth, in-flight,
-  served/rejected/quarantined counts, oldest-pending age).
+- ``{"op": "status"}`` — health snapshot (queue depth, in-flight request
+  id + age, served/rejected/quarantined counts, oldest-pending age).
+- ``{"op": "metrics"}`` — rolling serving metrics
+  (``telemetry/reqpath.py``): latency histograms with p50/p90/p99
+  (total / warm / cold), the queue-wait / build / execute split and
+  queue-wait share, per-op and per-client counters, rejected-by-reason,
+  queue-depth high-water mark.
 - ``{"op": "drain"}`` — graceful shutdown: finish everything admitted,
   reply to waiting clients, exit 0 (the in-band form of SIGTERM).
 - ``{"op": "ping"}`` — liveness.
 
-A request body is ``{"id": optional, "kind": "probe" | "simulate",
-"cells": [...]}`` — per-cell payloads are handler-specific
-(:mod:`blades_tpu.service.handlers`). Client-supplied ids make
-resubmission idempotent: a ``submit`` whose id the spool already holds a
-reply for is served from the spool, never re-executed.
+A request body is ``{"id": optional, "client": optional, "kind":
+"probe" | "simulate", "cells": [...]}`` — per-cell payloads are
+handler-specific (:mod:`blades_tpu.service.handlers`). Client-supplied
+ids make resubmission idempotent: a ``submit`` whose id the spool
+already holds a reply for is served from the spool, never re-executed.
+``client`` is an optional tenant label (same safe charset as ids) keyed
+into the per-client metrics tables — the hook per-tenant scheduling
+will build on.
 
 Stdlib-only, importable before jax (IMP001). Reference counterpart: none
 — the reference has no serving surface (``src/blades/simulator.py``).

@@ -44,6 +44,20 @@ flush-at-cell-boundary cadence — ``scripts/sweep_status.py`` and
 ``scripts/runs.py --run-id`` read service health (queue depth,
 in-flight/served/rejected/quarantined, oldest-pending age) from it live.
 
+Request-path accounting (PR 15, ``telemetry/reqpath.py``,
+docs/observability.md "Request-path accounting"): every request's
+lifecycle is stamped (admitted → spooled → queued → started → per-cell
+→ finished) and its wall tiled into queue-wait / build / execute on the
+finished ``request`` record, with a warm/cold classification from the
+compile mirror; the rolling :class:`~blades_tpu.telemetry.reqpath
+.MetricsRegistry` (latency histograms with p50/p90/p99, per-op and
+per-client counters, queue-depth high-water mark) answers ``op:
+metrics`` and is flushed as a schema-locked ``metrics_snapshot`` record
+at every health cadence — ``perf_report.py --check`` gates warm-request
+p99 and queue-wait share against the committed baseline. ``op: status``
+carries the in-flight request's id and age (not a bare 0/1), so a
+wedged request is attributable from the health surface alone.
+
 Module scope is stdlib-only (IMP001): the jax-importing pieces (the
 ``simulate`` handler, the resilient executor's retry-curve import chain)
 load inside the execution path, so a probe-only server — the chaos
@@ -73,6 +87,7 @@ from blades_tpu.supervision import heartbeat as _heartbeat
 from blades_tpu.telemetry import Recorder
 from blades_tpu.telemetry import context as _context
 from blades_tpu.telemetry import ledger as _ledger
+from blades_tpu.telemetry import reqpath as _reqpath
 
 __all__ = ["SimulationService", "TRACE_NAME"]
 
@@ -152,6 +167,7 @@ class _RequestAccounting:
                 rec_fields.setdefault("error_type", error_type)
         self.rec.event("sweep", **rec_fields)
         self.rec.flush()
+        self._svc.metrics.cell(self.request_id)
         self._svc._beat()
 
 
@@ -236,6 +252,11 @@ class SimulationService:
         self._state_lock = threading.Lock()
         self._pending_ts: Dict[str, float] = {}  # id -> admit time
         self._in_flight: Optional[str] = None
+        self._in_flight_since: Optional[float] = None
+        #: rolling request-path metrics (telemetry/reqpath.py): the
+        #: `op: metrics` reply body and the periodic `metrics_snapshot`
+        #: trace record both read from it
+        self.metrics = _reqpath.MetricsRegistry()
         self.served = 0
         self.rejected = 0
         self.quarantined_requests = 0
@@ -268,11 +289,20 @@ class SimulationService:
         with self._state_lock:
             pending = dict(self._pending_ts)
             in_flight = self._in_flight
+            in_flight_since = self._in_flight_since
         now = time.time()
         oldest = min(pending.values(), default=None)
         return {
             "queue_depth": self._queue.qsize(),
             "in_flight": 1 if in_flight else 0,
+            # the in-flight request's identity and age, not a bare 0/1:
+            # a wedged request must be attributable from this surface
+            **(
+                {"in_flight_id": in_flight,
+                 "in_flight_age_s": round(now - in_flight_since, 3)}
+                if in_flight and in_flight_since is not None
+                else {}
+            ),
             "served": self.served,
             "rejected": self.rejected,
             "quarantined_requests": self.quarantined_requests,
@@ -304,7 +334,17 @@ class SimulationService:
                 if snap["oldest_pending_age_s"] is not None
                 else {}
             ),
+            **{
+                k: snap[k]
+                for k in ("in_flight_id", "in_flight_age_s")
+                if k in snap
+            },
         )
+        # the rolling serving metrics ride the same cadence: one
+        # schema-locked snapshot record per health beat, so queue-wait
+        # share / warm p99 are queryable from the trace of a LIVE (or
+        # dead) server, not just over the socket
+        self.event("metrics_snapshot", **self.metrics.snapshot())
         self._last_health = time.monotonic()
 
     # -- listener --------------------------------------------------------------
@@ -364,6 +404,10 @@ class SimulationService:
             )
         elif op == "status":
             self._reply_and_close(f, conn, {"ok": True, **self._snapshot()})
+        elif op == "metrics":
+            self._reply_and_close(
+                f, conn, {"ok": True, **self.metrics.snapshot()}
+            )
         elif op == "result":
             rid = str(msg.get("id") or "")
             reply = self.spool.reply(rid)
@@ -411,6 +455,19 @@ class SimulationService:
                 return
         else:
             rid = None
+        kind = str(request.get("kind"))
+        client = request.get("client")
+        if client is not None:
+            try:
+                # tenant labels key the per-client metrics tables; hold
+                # them to the same safe charset as ids (they may become
+                # path segments once per-tenant scheduling lands)
+                client = safe_name(client, "client label")
+            except ValueError as e:
+                self._reply_and_close(f, conn, {"ok": False, "error": str(e)})
+                return
+        else:
+            client = "anon"
         # idempotent resubmission: a completed id is served from the
         # spool (never re-executed), a pending one is not double-queued
         if rid and self.spool.reply(rid) is not None:
@@ -427,6 +484,7 @@ class SimulationService:
             return
         if self._draining.is_set():
             self.rejected += 1
+            self.metrics.reject("draining", op=kind, client=client)
             self.event("service", event="reject", reason="draining",
                         queue_depth=self._queue.qsize())
             self._reply_and_close(
@@ -439,6 +497,7 @@ class SimulationService:
             # admission control: bounded queue, explicit reply — the
             # 1-core box must shed load, not absorb it into memory
             self.rejected += 1
+            self.metrics.reject("backpressure", op=kind, client=client)
             self.event("service", event="reject", reason="backpressure",
                         queue_depth=self._queue.qsize())
             self._reply_and_close(
@@ -448,14 +507,27 @@ class SimulationService:
                  "max_queue": self.max_queue},
             )
             return
+        # mint the id BEFORE spooling so the lifecycle path can stamp
+        # admitted → spooled → queued in true order
+        rid = rid or _protocol.mint_request_id()
+        path = self.metrics.admit(rid, op=kind, client=client)
         # spool FIRST, queue second: a crash between the two replays the
         # request on resume; the reverse would acknowledge lost work
-        rid = self.spool.admit(request, request_id=rid)
+        try:
+            rid = self.spool.admit(request, request_id=rid)
+        except Exception:
+            # a failed durable admission must not leak the open path in
+            # the registry (a long-lived server must not grow state per
+            # request): close it as a failed request, then let the
+            # listener's per-connection guard reply/close
+            self.metrics.finish(rid, outcome="error")
+            raise
+        path.stamp("spooled")
         with self._state_lock:
             self._pending_ts[rid] = time.time()
         self.event(
             "request", event="admitted", id=rid,
-            kind=str(request.get("kind")),
+            kind=kind,
             cells=len(request.get("cells") or []),
         )
         if msg.get("wait", True):
@@ -465,6 +537,8 @@ class SimulationService:
             self._reply_and_close(
                 f, conn, {"ok": True, "status": "accepted", "id": rid}
             )
+        path.stamp("queued")
+        self.metrics.queue_depth(self._queue.qsize())
 
     # -- worker ----------------------------------------------------------------
 
@@ -488,6 +562,16 @@ class SimulationService:
         with self._state_lock:
             admit_ts = self._pending_ts.get(rid)
         queue_age = time.time() - admit_ts if admit_ts else None
+        # request-path accounting: reuse the path the listener opened at
+        # admission (its queue-wait covers the real wait); direct callers
+        # (service_baseline, tests) get a fresh one with zero wait
+        path = self.metrics.get(rid)
+        if path is None:
+            path = self.metrics.admit(
+                rid, op=str(request.get("kind")),
+                client=str(request.get("client") or "anon"),
+            )
+        path.start()
         entry = _ledger.run_started(
             "request",
             config={
@@ -503,7 +587,8 @@ class SimulationService:
             error = f"{type(e).__name__}: {e}"[:300]
             self.event("request", event="finished", id=rid,
                         outcome="error", error=error,
-                        wall_s=round(time.perf_counter() - t0, 6))
+                        wall_s=round(time.perf_counter() - t0, 6),
+                        **self.metrics.finish(rid, outcome="error"))
             entry.ended("crashed", error=error)
             return {"ok": False, "id": rid, "status": "error",
                     "error": error}
@@ -556,7 +641,8 @@ class SimulationService:
             error = f"{type(e).__name__}: {e}"[:300]
             self.event("request", event="finished", id=rid,
                         outcome="error", error=error,
-                        wall_s=round(time.perf_counter() - t0, 6))
+                        wall_s=round(time.perf_counter() - t0, 6),
+                        **self.metrics.finish(rid, outcome="error"))
             entry.ended("crashed", error=error)
             return {"ok": False, "id": rid, "status": "error",
                     "error": error}
@@ -580,12 +666,20 @@ class SimulationService:
         if quarantined:
             self.quarantined_requests += 1
         self.served += 1
+        # close the lifecycle path: the finished record carries the
+        # queue-wait / build / execute split (it tiles total_s) and the
+        # warm/cold classification alongside the execution wall
+        split = self.metrics.finish(
+            rid, outcome=outcome, retried=report.retried,
+            quarantined_cells=len(quarantined),
+        )
         self.event(
             "request", event="finished", id=rid, outcome=outcome,
             cells=len(cells), executed=report.executed,
             resumed_cells=report.resumed_skipped,
             quarantined=len(quarantined), retried=report.retried,
             wall_s=round(wall, 6),
+            **split,
         )
         entry.ended("finished", metrics={
             "cells": len(cells),
@@ -624,12 +718,14 @@ class SimulationService:
                 continue
             with self._state_lock:
                 self._in_flight = rid
+                self._in_flight_since = time.time()
             reply = self._execute(rid, request)
             # spool before replying: the reply must be fetchable (op:
             # result) even if the waiting client died with the connection
             self.spool.complete(rid, reply)
             with self._state_lock:
                 self._in_flight = None
+                self._in_flight_since = None
                 self._pending_ts.pop(rid, None)
             if waiter is not None:
                 f, conn = waiter
@@ -693,7 +789,16 @@ class SimulationService:
         for rid, request in pending:
             with self._state_lock:
                 self._pending_ts[rid] = time.time()
+            # a resumed request's lifecycle restarts at the relaunch:
+            # queue-wait measures THIS attempt's wait, not the outage
+            path = self.metrics.admit(
+                rid, op=str(request.get("kind")),
+                client=str(request.get("client") or "anon"),
+            )
+            path.stamp("spooled")
             self._queue.put((rid, request, None))
+            path.stamp("queued")
+        self.metrics.queue_depth(self._queue.qsize())
         self.event(
             "service", event="start", socket=self.socket_path,
             queue_depth=self._queue.qsize(),

@@ -95,6 +95,7 @@ API_MODULES = [
     "blades_tpu.telemetry.ledger",
     "blades_tpu.telemetry.alerts",
     "blades_tpu.telemetry.timeline",
+    "blades_tpu.telemetry.reqpath",
     "blades_tpu.simulator",
     "blades_tpu.client",
     "blades_tpu.server",

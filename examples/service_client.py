@@ -13,8 +13,11 @@ its unix-domain socket:
    seeded synthetic dataset; the second is served from the warm
    ``EngineCache`` with zero new compiles and must return bit-identical
    results (the warm-serving claim ``perf_report.py --check`` gates);
-4. a live health snapshot (``op: status``) and a graceful drain — the
-   server finishes everything admitted and exits 0.
+4. a live health snapshot (``op: status``) and the rolling serving
+   metrics (``op: metrics`` — warm/cold request counts, the queue-wait/
+   build/execute split, warm p99; ``blades_tpu/telemetry/reqpath.py``),
+   then a graceful drain — the server finishes everything admitted and
+   exits 0.
 
 Every admitted request is journaled to an on-disk spool first, so a
 SIGKILLed server replays it on relaunch under ``BLADES_RESUME=1`` and
@@ -97,6 +100,15 @@ def _drive(client, args) -> None:
     status = client.status()
     print("status -> served={served} rejected={rejected} "
           "quarantined_requests={quarantined_requests}".format(**status))
+
+    # request-path accounting (telemetry/reqpath.py): the rolling
+    # serving metrics — warm/cold classification, the queue-wait /
+    # build / execute split, warm p99 — live over `op: metrics`
+    metrics = client.metrics()
+    split = metrics["split"]
+    print("metrics -> warm={warm} cold={cold}".format(**metrics["requests"]))
+    print(f"metrics -> queue_wait_share={split['queue_wait_share']}, "
+          f"warm p99 <= {metrics['latency']['warm'].get('p99_s')}s")
 
 
 if __name__ == "__main__":

@@ -557,6 +557,12 @@ def sweep(
 # server; drain exits 0 with zero lost requests; and (full slice) SIGKILL
 # mid-request + supervised relaunch resumes from spool+journal, executes
 # only the unjournaled cells, and replies content-identically.
+#
+# PR 15: the drills also hold the request-path metrics invariants
+# (telemetry/reqpath.py, `op: metrics`) — the rejected counter matches
+# the explicit backpressure replies the clients saw, and the quarantine
+# counters match the attributable per-cell error records in the replies
+# — so the metrics surface cannot drift from the behavior it reports.
 
 SERVE = os.path.join(REPO, "scripts", "serve.py")
 
@@ -607,7 +613,9 @@ def _finish_server(proc, client) -> int:
 
 def _scn_poison(out_dir: str) -> dict:
     """A poison request is quarantined (attributable error) while its
-    innocent cells and a neighboring request complete untouched."""
+    innocent cells and a neighboring request complete untouched — and
+    the metrics quarantine counters match the attributable error
+    records in the reply exactly."""
     proc, client = _start_server(os.path.join(out_dir, "poison"))
     try:
         neighbor = client.submit(
@@ -624,6 +632,19 @@ def _scn_poison(out_dir: str) -> dict:
             {"kind": "probe", "cells": [{"label": "a0", "op": "ok"}]}
         )
         cells = {c["label"]: c for c in poison.get("cells", [])}
+        quarantined_cells = [
+            c for c in poison.get("cells", []) if c.get("quarantined")
+        ]
+        # metrics invariant: the registry's quarantine counters equal
+        # the attributable error records the client actually received
+        metrics = client.metrics()
+        m_reqs = metrics.get("requests") or {}
+        m_cells = metrics.get("cells") or {}
+        metrics_consistent = (
+            m_reqs.get("quarantined") == 1
+            and m_cells.get("quarantined") == len(quarantined_cells)
+            and m_reqs.get("rejected") == 0
+        )
         ok = (
             poison.get("status") == "done"
             and not poison.get("ok")
@@ -633,9 +654,13 @@ def _scn_poison(out_dir: str) -> dict:
             and "result" in cells["good0"] and "result" in cells["good1"]
             and neighbor_reply["reply"]["ok"]
             and after.get("ok")
+            and metrics_consistent
         )
         return {"name": "poison_isolated", "ok": bool(ok),
-                "quarantined": [c for c in cells if cells[c].get("quarantined")]}
+                "quarantined": [c for c in cells if cells[c].get("quarantined")],
+                "metrics_consistent": bool(metrics_consistent),
+                "metrics_quarantined_requests": m_reqs.get("quarantined"),
+                "metrics_quarantined_cells": m_cells.get("quarantined")}
     finally:
         _finish_server(proc, client)
 
@@ -664,14 +689,29 @@ def _scn_backpressure(out_dir: str) -> dict:
             wait=False,
         )
         drained = client.wait_result(queued["id"], timeout=30)
+        # metrics invariant: the rejected counter equals the explicit
+        # backpressure replies the client saw — one, by reason
+        metrics = client.metrics()
+        backpressure_replies = 1 if rejected.get("rejected") else 0
+        metrics_consistent = (
+            (metrics.get("requests") or {}).get("rejected")
+            == backpressure_replies
+            and (metrics.get("rejected_by_reason") or {}).get("backpressure")
+            == backpressure_replies
+            and (metrics.get("queue") or {}).get("depth_hwm", 0) >= 1
+        )
         ok = (
             busy.get("status") == "accepted"
             and queued.get("status") == "accepted"
             and rejected.get("rejected") == "backpressure"
             and drained["reply"]["ok"]
+            and metrics_consistent
         )
         return {"name": "backpressure", "ok": bool(ok),
-                "rejected_reply": rejected}
+                "rejected_reply": rejected,
+                "metrics_consistent": bool(metrics_consistent),
+                "metrics_rejected_by_reason":
+                    metrics.get("rejected_by_reason")}
     finally:
         _finish_server(proc, client)
 
@@ -692,14 +732,24 @@ def _scn_deadline(out_dir: str) -> dict:
             {"kind": "probe", "cells": [{"label": "ok", "op": "ok"}]}
         )
         cells = {c["label"]: c for c in hung.get("cells", [])}
+        # metrics invariant: the deadline-tripped cell shows up as one
+        # retried + one quarantined cell in the registry
+        metrics = client.metrics()
+        m_cells = metrics.get("cells") or {}
+        metrics_consistent = (
+            m_cells.get("quarantined") == 1 and m_cells.get("retried", 0) >= 1
+        )
         ok = (
             hung.get("status") == "done"
             and cells["hang"].get("quarantined")
             and cells["hang"].get("error_type") == "DeadlineExceeded"
             and cells["after"].get("result", {}).get("value") == 7
             and alive.get("ok")
+            and metrics_consistent
         )
-        return {"name": "deadline_hang", "ok": bool(ok)}
+        return {"name": "deadline_hang", "ok": bool(ok),
+                "metrics_consistent": bool(metrics_consistent),
+                "metrics_cells": m_cells}
     finally:
         _finish_server(proc, client)
 

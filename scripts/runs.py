@@ -13,8 +13,10 @@ completed/total, last-cell key + age, ETA) read from the per-cell
 (``blades_tpu/telemetry/timeline.py``), so a stuck sweep is
 distinguishable from a slow one without reading the raw trace; service
 runs (``blades_tpu/service``) get a ``service_health`` block the same
-way — queue depth, in-flight/served/rejected/quarantined counts,
-oldest-pending age.
+way — queue depth, the in-flight request's id + age, served/rejected/
+quarantined counts, oldest-pending age + trend, and (from the latest
+``metrics_snapshot`` record, ``telemetry/reqpath.py``) queue-wait
+share and warm-request p99.
 With ``--tunnel`` it additionally summarizes the TPU tunnel probe log
 (``results/tpu_r5/tunnel_probes.jsonl``, written by
 ``scripts/tpu_capture.py``) into availability windows — up fraction,
@@ -222,12 +224,14 @@ def service_health(
     records: Optional[List[Dict[str, Any]]] = None,
 ) -> Optional[Dict[str, Any]]:
     """Service health for a ``service`` run's attempt trail, from the
-    ``service``/``request`` records in its registered trace artifacts
-    (``blades_tpu/service`` registers ``service_trace.jsonl`` on its
-    STARTED ledger record, so a LIVE server is queryable). Same rollup as
-    ``sweep_status.summarize_service`` — queue depth, in-flight,
-    served/rejected/quarantined, oldest-pending age. ``None`` when the
-    trail has no service records."""
+    ``service``/``request``/``metrics_snapshot`` records in its
+    registered trace artifacts (``blades_tpu/service`` registers
+    ``service_trace.jsonl`` on its STARTED ledger record, so a LIVE
+    server is queryable). Same rollup as
+    ``sweep_status.summarize_service`` — queue depth, the in-flight
+    request's id + age, served/rejected/quarantined, oldest-pending age
+    + trend, queue-wait share, warm p99. ``None`` when the trail has no
+    service records."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from sweep_status import summarize_service
 

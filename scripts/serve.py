@@ -16,7 +16,14 @@ Usage::
     python scripts/serve.py submit --socket S --request @req.json --no-wait
     python scripts/serve.py result --socket S --id req-... [--wait 120]
     python scripts/serve.py status --socket S
+    python scripts/serve.py metrics --socket S
     python scripts/serve.py drain  --socket S
+
+``metrics`` prints the rolling serving metrics (``telemetry/
+reqpath.py``): latency p50/p90/p99 (total / warm / cold), the
+queue-wait / build / execute split + queue-wait share, per-op and
+per-client counters, queue-depth high-water mark — the live form of the
+``metrics_snapshot`` records in ``<out>/service_trace.jsonl``.
 
 ``start`` honors ``BLADES_RESUME=1`` (what the supervisor exports on
 relaunch): the spool's pending requests re-queue and execute only their
@@ -112,6 +119,12 @@ def _status(args) -> int:
     return 0 if reply.get("ok") else 1
 
 
+def _metrics(args) -> int:
+    reply = _client(args).metrics()
+    print(json.dumps({"metric": f"{METRIC}_metrics", **reply}))
+    return 0 if reply.get("ok") else 1
+
+
 def _drain(args) -> int:
     reply = _client(args).drain()
     print(json.dumps({"metric": f"{METRIC}_drain", **reply}))
@@ -142,6 +155,7 @@ def _run(argv: Optional[list] = None) -> int:
         ("submit", _submit, "request"),
         ("result", _result, "id"),
         ("status", _status, None),
+        ("metrics", _metrics, None),
         ("drain", _drain, None),
     ):
         pc = sub.add_parser(name)

@@ -15,9 +15,13 @@ cells advancing — distinguishable without reading the raw trace
 
 Simulation-service traces (``blades_tpu/service`` —
 ``<out>/service_trace.jsonl``) get an additional ``service`` block:
-queue depth, in-flight, served/rejected/quarantined counts,
-oldest-pending age — a wedged server (pending aging, cells frozen) is
-distinguishable from a busy one and from an idle one.
+queue depth, the in-flight request's id + age, served/rejected/
+quarantined counts, oldest-pending age plus its trend across the last
+two health records (a wedged server shows the age GROWING between
+snapshots, cells frozen — distinguishable from busy and from idle),
+and — from the latest ``metrics_snapshot`` record
+(``telemetry/reqpath.py``) — the rolling serving metrics: queue-wait
+share, warm-request p99, queue-depth high-water mark.
 
 Usage::
 
@@ -195,6 +199,7 @@ def summarize_service(
     now = time.time() if now is None else now
     svc = [r for r in records if r.get("t") == "service"]
     reqs = [r for r in records if r.get("t") == "request"]
+    snaps = [r for r in records if r.get("t") == "metrics_snapshot"]
     if not svc and not reqs:
         return None
     out: Dict[str, Any] = {}
@@ -205,13 +210,45 @@ def summarize_service(
     # the wedged-vs-idle signal this block exists for
     snap = next((r for r in reversed(svc) if "served" in r), None)
     if snap is not None:
-        for field in ("queue_depth", "in_flight", "served", "rejected",
+        for field in ("queue_depth", "in_flight", "in_flight_id",
+                      "in_flight_age_s", "served", "rejected",
                       "quarantined_requests", "oldest_pending_age_s",
                       "draining", "uptime_s"):
             if field in snap:
                 out[field] = snap[field]
+    # oldest-pending age TREND across the last two health records that
+    # carry the field: a wedged server's age grows snapshot-over-
+    # snapshot; a merely busy one's resets as requests drain. Gated on
+    # the LATEST snapshot still carrying an age — an idle server whose
+    # newest records omit the field must not resurrect a stale trend
+    # (the same last-snapshot-stands discipline as the fields above)
+    ages = [
+        (r["ts"], r["oldest_pending_age_s"])
+        for r in svc
+        if isinstance(r.get("ts"), (int, float))
+        and isinstance(r.get("oldest_pending_age_s"), (int, float))
+    ]
+    if len(ages) >= 2 and "oldest_pending_age_s" in out:
+        out["pending_age_trend_s"] = round(ages[-1][1] - ages[-2][1], 3)
+    # rolling serving metrics (`metrics_snapshot` records,
+    # telemetry/reqpath.py): the latest snapshot's headline numbers —
+    # queue-wait share (what a scheduler must move), warm p99 (what an
+    # SLO can promise), queue-depth high-water mark
+    if snaps:
+        m = snaps[-1]
+        split = m.get("split") or {}
+        if "queue_wait_share" in split:
+            out["queue_wait_share"] = split["queue_wait_share"]
+        warm = (m.get("latency") or {}).get("warm") or {}
+        if warm.get("count"):
+            out["warm_p99_s"] = warm.get("p99_s")
+            out["warm_requests"] = warm.get("count")
+        hwm = (m.get("queue") or {}).get("depth_hwm")
+        if hwm is not None:
+            out["queue_depth_hwm"] = hwm
     last_ts = max(
-        (r["ts"] for r in svc + reqs if isinstance(r.get("ts"), (int, float))),
+        (r["ts"] for r in svc + reqs + snaps
+         if isinstance(r.get("ts"), (int, float))),
         default=None,
     )
     if last_ts is not None:

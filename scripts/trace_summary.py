@@ -3,7 +3,12 @@
 Reads a trace written by ``blades_tpu.telemetry`` (``telemetry.jsonl`` in a
 run's log dir) and prints where the rounds spent their time — span tree
 totals (sample / dispatch / sync / eval), XLA compile + persistent-cache
-accounting, and defense-forensics summaries. This subsumes the role of
+accounting, and defense-forensics summaries. Service traces
+(``service_trace.jsonl``) additionally get a serving-path section
+(``telemetry/reqpath.py``): per-request queue-wait/build/execute split
+totals, warm/cold request counts, warm p99 and queue-wait share from
+the latest ``metrics_snapshot`` record — with ``--compare`` rows for
+both headline numbers. This subsumes the role of
 ``scripts/stage_timing.py`` for CPU runs: stage_timing re-times stages with
 a dedicated harness, while every normal run now carries its own breakdown
 for free.
@@ -57,6 +62,9 @@ def summarize(records: List[dict]) -> dict:
     metrics = []
     timelines = []
     sweep_cells = []
+    service_events = []
+    request_events = []
+    metrics_snapshots = []
     programs = []
     profile_events = []
     margins = []
@@ -97,6 +105,12 @@ def summarize(records: List[dict]) -> dict:
             timelines.append(r)
         elif t == "sweep":
             sweep_cells.append(r)
+        elif t == "service":
+            service_events.append(r)
+        elif t == "request":
+            request_events.append(r)
+        elif t == "metrics_snapshot":
+            metrics_snapshots.append(r)
         elif t == "async":
             asyncs.append(r)
         elif t == "memory":
@@ -308,6 +322,52 @@ def summarize(records: List[dict]) -> dict:
             )
         sweep_summary = fams
 
+    # serving-path accounting (`service`/`request`/`metrics_snapshot`
+    # records, blades_tpu/service + telemetry/reqpath.py): per-request
+    # queue-wait/build/execute split totals, warm/cold request counts,
+    # and the latest rolling-metrics snapshot's headline numbers — the
+    # post-mortem rollup of a service trace (sweep_status owns the live
+    # view)
+    service_summary: Dict[str, Any] = {}
+    if service_events or request_events or metrics_snapshots:
+        finished = [
+            r for r in request_events if r.get("event") == "finished"
+        ]
+        service_summary["requests_finished"] = len(finished)
+        for key in ("queue_wait_s", "build_s", "execute_s", "total_s"):
+            vals = [r[key] for r in finished if key in r]
+            if vals:
+                service_summary[key] = round(sum(vals), 6)
+        tot = service_summary.get("total_s")
+        if tot:
+            service_summary["queue_wait_share"] = round(
+                service_summary.get("queue_wait_s", 0.0) / tot, 4
+            )
+        warm_flags = [r["warm"] for r in finished if "warm" in r]
+        if warm_flags:
+            service_summary["warm_requests"] = sum(warm_flags)
+            service_summary["cold_requests"] = (
+                len(warm_flags) - sum(warm_flags)
+            )
+        exit_snap = next(
+            (r for r in reversed(service_events) if "served" in r), None
+        )
+        if exit_snap is not None:
+            for key in ("served", "rejected", "quarantined_requests"):
+                if key in exit_snap:
+                    service_summary[key] = exit_snap[key]
+        if metrics_snapshots:
+            m = metrics_snapshots[-1]
+            warm = (m.get("latency") or {}).get("warm") or {}
+            if warm.get("count"):
+                service_summary["warm_p99_s"] = warm.get("p99_s")
+            total_lat = (m.get("latency") or {}).get("total") or {}
+            if total_lat.get("count"):
+                service_summary["total_p99_s"] = total_lat.get("p99_s")
+            hwm = (m.get("queue") or {}).get("depth_hwm")
+            if hwm is not None:
+                service_summary["queue_depth_hwm"] = hwm
+
     # measured program profiles (`memory` records): cost-model flops /
     # bytes + compiled buffer budget per program, next to the analytical
     # peak_update_bytes gauge above
@@ -383,6 +443,7 @@ def summarize(records: List[dict]) -> dict:
         "memory": memory_summary,
         "dispatch": dispatch_summary,
         "sweep": sweep_summary,
+        "service": service_summary,
         "metrics": metrics_summary,
         "programs": program_summary,
         "heartbeat": heartbeat_summary,
@@ -513,6 +574,23 @@ def format_table(summary: dict) -> str:
             f"(overhead {f['per_cell_overhead_s'] * 1e3:.0f}ms/cell, "
             f"compile {f['compile_s']:.2f}s of {f['wall_s']:.2f}s wall)"
         )
+    svc = summary.get("service") or {}
+    if svc:
+        parts = [f"requests={svc.get('requests_finished', 0)}"]
+        if "warm_requests" in svc:
+            parts.append(
+                f"warm={svc['warm_requests']} cold={svc['cold_requests']}"
+            )
+        if "queue_wait_share" in svc:
+            parts.append(f"queue_wait_share={svc['queue_wait_share']:.3f}")
+        if "warm_p99_s" in svc:
+            parts.append(f"warm_p99={svc['warm_p99_s'] * 1e3:.0f}ms")
+        if "queue_depth_hwm" in svc:
+            parts.append(f"depth_hwm={svc['queue_depth_hwm']}")
+        for key in ("served", "rejected", "quarantined_requests"):
+            if key in svc:
+                parts.append(f"{key}={svc[key]}")
+        lines.append(f"service: {'  '.join(parts)}")
     progs = summary.get("programs") or {}
     for name, p in sorted(progs.items()):
         pairs = ", ".join(
@@ -678,6 +756,24 @@ def compare_format(sa: dict, sb: dict, la: str = "A", lb: str = "B") -> str:
         fb = f"{vb:>12.3f}" if vb is not None else f"{'—':>12}"
         rr = ratio(va, vb) if va is not None and vb is not None else f"{'—':>8}"
         lines.append(f"{'dispatch_share':<28}{fa}{fb}{rr}")
+    # serving-path accounting: warm p99 + queue-wait share — the rows a
+    # scheduling/serving PR must show moving
+    va_s, vb_s = sa.get("service") or {}, sb.get("service") or {}
+    if va_s or vb_s:
+        for key, label, scale in (
+            ("warm_p99_s", "service warm p99 (ms)", 1e3),
+            ("total_p99_s", "service total p99 (ms)", 1e3),
+            ("queue_wait_share", "service queue_wait_share", 1.0),
+        ):
+            va, vb = va_s.get(key), vb_s.get(key)
+            if va is None and vb is None:
+                continue
+            fmt = (lambda v: f"{v * scale:>12.1f}") if scale != 1.0 else (
+                lambda v: f"{v:>12.3f}")
+            fa = fmt(va) if va is not None else f"{'—':>12}"
+            fb = fmt(vb) if vb is not None else f"{'—':>12}"
+            rr = ratio(va, vb) if va is not None and vb is not None else f"{'—':>8}"
+            lines.append(f"{label:<28}{fa}{fb}{rr}")
     # sweep accounting: per-cell wall + build overhead per family
     wa, wb = sa.get("sweep") or {}, sb.get("sweep") or {}
     for fam in sorted(set(wa) | set(wb)):

@@ -447,6 +447,59 @@ def test_schema001_sees_service_emitters(tmp_path):
     ]
 
 
+def test_imp001_covers_reqpath_module(tmp_path):
+    """PR 15 surface: the request-path accounting module
+    (`telemetry/reqpath.py`) entered the pre-jax contract set — it is
+    consumed by the probe-only server and every metrics/status query
+    surface. A module-scope jax import there must fire IMP001 (fire
+    direction; HEAD silence is test_tier_a_silent_on_head, runtime side
+    is test_import_reqpath_before_jax)."""
+    tel = tmp_path / "blades_tpu" / "telemetry"
+    tel.mkdir(parents=True)
+    (tel / "reqpath.py").write_text(
+        '"""Doc. Reference counterpart: none — test module."""\n'
+        "import jax\n"
+    )
+    violations, _ = run_rules(RepoIndex(str(tmp_path)), all_rules())
+    assert [v.rule for v in violations] == ["IMP001"], [
+        str(v) for v in violations
+    ]
+    assert violations[0].path == "blades_tpu/telemetry/reqpath.py"
+
+
+def test_schema001_sees_metrics_snapshot_emitter(tmp_path):
+    """PR 15 surface, both directions: the static emit scan SEES the
+    `metrics_snapshot` emitter on HEAD (the v6 declaration cannot
+    outlive its emitter), and an undeclared metrics_snapshot emit in a
+    fixture tree fires SCHEMA001."""
+    from blades_tpu.analysis.rules.schema_drift import emitted_types
+
+    emitted = {t for t, _, _ in emitted_types(RepoIndex(REPO))}
+    assert "metrics_snapshot" in emitted, sorted(emitted)
+
+    svc = tmp_path / "blades_tpu" / "service"
+    svc.mkdir(parents=True)
+    (svc / "server.py").write_text(textwrap.dedent(
+        '''\
+        """Doc. Reference counterpart: none — test module."""
+
+
+        def emit(rec):
+            rec.event("metrics_snapshot", uptime_s=1.0)
+        '''
+    ))
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "telemetry_schema.json").write_text(
+        json.dumps({"types": {"meta": {}}})
+    )
+    violations, _ = run_rules(RepoIndex(str(tmp_path)), all_rules())
+    hits = [v for v in violations if v.rule == "SCHEMA001"]
+    assert len(hits) == 1 and "'metrics_snapshot'" in hits[0].message, [
+        str(v) for v in violations
+    ]
+
+
 def test_alias001_catches_with_statement_load(tmp_path):
     """Regression (review finding): `with np.load(path) as z:` is the
     documented numpy idiom for NpzFile and must taint the bound archive
@@ -672,6 +725,15 @@ def test_import_service_before_jax():
         "import blades_tpu.service, blades_tpu.service.server, "
         "blades_tpu.service.handlers"
     )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_import_reqpath_before_jax():
+    """PR 15 contract: the request-path accounting layer must be
+    importable without jax — serving metrics are queried from hosts
+    where the tunnel is down, and the probe-only server folds every
+    request into it jax-free."""
+    proc = _import_probe("import blades_tpu.telemetry.reqpath")
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 

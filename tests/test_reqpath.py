@@ -1,0 +1,210 @@
+"""Request-path accounting (`blades_tpu/telemetry/reqpath.py`): the
+split math (queue-wait + build + execute tiles each request's wall),
+warm/cold classification from the compile mirror, exact fixed-bin
+histogram percentiles on synthetic streams, the rolling metrics
+registry's counters/high-water marks, and the schema lock on the
+snapshot record shape.
+
+All tests drive injectable clocks/counters — no server, no jax, no
+sleeping.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from blades_tpu.telemetry.reqpath import (  # noqa: E402
+    Histogram,
+    MetricsRegistry,
+    RequestPath,
+)
+from blades_tpu.telemetry.schema import load_schema, validate_records  # noqa: E402
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- split math ----------------------------------------------------------------
+
+
+def test_split_tiles_the_request_wall():
+    """queue_wait_s + build_s + execute_s == total_s, with the stamps
+    driving each term: 2 s of queue wait, then 5 s of execution of which
+    1.5 s was trace+compile (the compile-mirror delta)."""
+    clk = FakeClock()
+    path = RequestPath("r1", op="simulate", client="t0", clock=clk)
+    clk.advance(0.5)
+    path.stamp("spooled")
+    path.stamp("queued")
+    clk.advance(1.5)  # 2.0 s total between admitted and started
+    path.start(counters={"xla.compiles": 10, "xla.compile_s": 40.0,
+                         "xla.trace_s": 8.0})
+    clk.advance(5.0)
+    fields = path.finish(counters={"xla.compiles": 12,
+                                   "xla.compile_s": 41.0,
+                                   "xla.trace_s": 8.5})
+    assert fields["queue_wait_s"] == 2.0
+    assert fields["build_s"] == 1.5
+    assert fields["execute_s"] == 3.5
+    assert fields["total_s"] == 7.0
+    assert (
+        fields["queue_wait_s"] + fields["build_s"] + fields["execute_s"]
+        == fields["total_s"]
+    )
+    assert fields["warm"] is False and fields["compiles"] == 2
+
+
+def test_warm_cold_classification_via_compile_mirror():
+    """Zero compile-count delta across the execution window == warm; a
+    warm request's build share is zero and its wall is pure execute."""
+    clk = FakeClock()
+    c0 = {"xla.compiles": 7, "xla.compile_s": 30.0, "xla.trace_s": 5.0}
+    path = RequestPath("r2", clock=clk)
+    path.start(counters=c0)
+    clk.advance(0.25)
+    fields = path.finish(counters=dict(c0))
+    assert fields["warm"] is True and fields["compiles"] == 0
+    assert fields["build_s"] == 0.0
+    assert fields["execute_s"] == 0.25 and fields["total_s"] == 0.25
+
+
+def test_build_clamped_to_execution_wall():
+    """A compile-seconds delta larger than the observed wall (another
+    thread compiling concurrently) must clamp: execute_s never goes
+    negative and the tiling invariant holds."""
+    clk = FakeClock()
+    path = RequestPath("r3", clock=clk)
+    path.start(counters={"xla.compiles": 0, "xla.compile_s": 0.0})
+    clk.advance(1.0)
+    fields = path.finish(counters={"xla.compiles": 3,
+                                   "xla.compile_s": 9.0})
+    assert fields["build_s"] == 1.0 and fields["execute_s"] == 0.0
+    assert fields["total_s"] == 1.0 and fields["warm"] is False
+
+
+def test_never_started_request_is_all_queue_wait():
+    clk = FakeClock()
+    path = RequestPath("r4", clock=clk)
+    clk.advance(3.0)
+    fields = path.finish()
+    assert fields["queue_wait_s"] == 3.0 and fields["total_s"] == 3.0
+    assert fields["build_s"] == 0.0 and fields["execute_s"] == 0.0
+
+
+# -- histogram -----------------------------------------------------------------
+
+
+def test_histogram_percentile_edges_exact_on_synthetic_stream():
+    """A 100-observation stream placed on known bins: percentiles report
+    the exact upper edge of the rank's bin (1-2-5 ladder)."""
+    h = Histogram()
+    for _ in range(50):
+        h.observe(0.0008)   # bin (0, 0.001]
+    for _ in range(40):
+        h.observe(0.09)     # bin (0.05, 0.1]
+    for _ in range(9):
+        h.observe(4.0)      # bin (2, 5]
+    h.observe(90.0)         # bin (50, 100]
+    assert h.count == 100
+    assert h.percentile(0.50) == 0.001
+    assert h.percentile(0.90) == 0.1
+    assert h.percentile(0.99) == 5.0
+    assert h.percentile(1.00) == 100.0
+    d = h.to_dict()
+    assert d["p50_s"] == 0.001 and d["p90_s"] == 0.1 and d["p99_s"] == 5.0
+    assert d["max_s"] == 90.0 and d["count"] == 100
+
+
+def test_histogram_overflow_bin_reports_observed_max():
+    h = Histogram()
+    h.observe(50000.0)  # beyond the last edge
+    h.observe(0.01)
+    assert h.percentile(0.99) == 50000.0  # overflow: observed max
+    assert h.percentile(0.5) == 0.01
+
+
+def test_histogram_empty_and_degenerate_values():
+    h = Histogram()
+    assert h.percentile(0.99) is None
+    assert h.to_dict() == {"count": 0}
+    h.observe(-1.0)          # clock skew folds to 0
+    h.observe(float("nan"))  # never poisons the bins
+    assert h.count == 2 and h.percentile(0.99) == Histogram.EDGES[0]
+
+
+# -- registry ------------------------------------------------------------------
+
+
+def test_registry_counters_rejections_and_hwm():
+    clk = FakeClock()
+    reg = MetricsRegistry(clock=clk)
+    p = reg.admit("a", op="probe", client="tenant1")
+    reg.queue_depth(3)
+    reg.queue_depth(1)  # high-water mark keeps the max
+    clk.advance(1.0)
+    p.start(counters={"xla.compiles": 0})
+    reg.cell("a")
+    reg.cell("a")
+    clk.advance(2.0)
+    fields = reg.finish("a", outcome="quarantined", retried=2,
+                        quarantined_cells=1,
+                        counters={"xla.compiles": 0})
+    assert fields["warm"] is True
+    reg.reject("backpressure", op="probe", client="tenant2")
+    reg.reject("backpressure", op="probe", client="tenant2")
+    reg.reject("draining", op="simulate", client="tenant1")
+    snap = reg.snapshot()
+    assert snap["requests"] == {
+        "admitted": 1, "served": 1, "failed": 0, "rejected": 3,
+        "quarantined": 1, "warm": 1, "cold": 0,
+    }
+    assert snap["cells"] == {"done": 2, "retried": 2, "quarantined": 1}
+    assert snap["rejected_by_reason"] == {"backpressure": 2, "draining": 1}
+    assert snap["queue"]["depth_hwm"] == 3
+    assert snap["by_client"]["tenant1"] == {"admitted": 1, "served": 1,
+                                            "rejected": 1}
+    assert snap["by_client"]["tenant2"] == {"rejected": 2}
+    assert snap["by_op"]["probe"]["served"] == 1
+    # split sums: 1 s queue wait + 2 s execute
+    assert snap["split"]["queue_wait_s"] == 1.0
+    assert snap["split"]["total_s"] == 3.0
+    assert snap["split"]["queue_wait_share"] == round(1.0 / 3.0, 6)
+    # unknown ids never fail accounting
+    assert reg.finish("ghost") == {}
+
+
+def test_registry_error_outcome_counts_failed_not_served():
+    reg = MetricsRegistry(clock=FakeClock())
+    reg.admit("a", op="probe")
+    reg.finish("a", outcome="error")
+    snap = reg.snapshot()
+    assert snap["requests"]["failed"] == 1
+    assert snap["requests"]["served"] == 0
+    # never started: classified neither warm nor cold
+    assert snap["requests"]["warm"] == 0 and snap["requests"]["cold"] == 0
+
+
+def test_snapshot_record_validates_against_committed_schema():
+    """The registry snapshot IS the `metrics_snapshot` record body: it
+    must carry exactly the schema-declared fields (the closed v6 type),
+    so the server can splat it into `event()` unchanged."""
+    reg = MetricsRegistry(clock=FakeClock())
+    p = reg.admit("a", op="probe")
+    p.start(counters={})
+    reg.finish("a", counters={})
+    rec = {"t": "metrics_snapshot", "ts": 1.0, **reg.snapshot()}
+    schema = load_schema()
+    assert validate_records([rec], schema) == []
+    # the snapshot is JSON-serializable as-is (the wire reply body)
+    json.dumps(rec)

@@ -4,7 +4,12 @@ under concurrent writers, request-level fault isolation (poison
 quarantine with sibling+neighbor salvage, deadline-tripped hangs,
 backpressure), drain-with-zero-loss, the supervised SIGKILL → resume →
 content-identical e2e, warm-cache serving with a zero-new-compiles pin,
-and the health surfaces (`sweep_status`, `runs.py`) + perf-gate guard.
+the request-path accounting surfaces (PR 15: in-flight id/age on
+`op: status`, `op: metrics` + `serve.py metrics`, warm/cold
+classification and the queue-wait/build/execute split on the finished
+records and metrics snapshots), and the health surfaces
+(`sweep_status`, `runs.py`) + perf-gate guards (warm cell wall, warm
+p99, queue-wait share — fire and pass directions).
 
 Probe-request scenarios run against REAL server subprocesses and never
 import jax (the server is up in ~1s), so the tier-1 slice stays cheap;
@@ -265,6 +270,23 @@ def test_trace_schema_and_health_surfaces(tmp_path):
     assert svc["requests"]["by_outcome"] == {"ok": 1, "quarantined": 1}
     # the per-cell accounting rides ordinary sweep records
     assert payload["sweeps"]["service"]["cells"] == 2
+    # request-path metrics from the trace's metrics_snapshot records:
+    # probe requests classify warm, the split is live
+    assert svc["warm_requests"] == 2
+    assert svc["warm_p99_s"] is not None
+    assert 0.0 <= svc["queue_wait_share"] <= 1.0
+
+    # trace_summary's service section reads the same trace
+    import trace_summary
+    s = trace_summary.summarize(trace_summary.load_records(trace))
+    assert s["service"]["requests_finished"] == 2
+    assert s["service"]["warm_requests"] == 2
+    assert s["service"]["warm_p99_s"] is not None
+    assert "queue_wait_share" in s["service"]
+    assert s["service"]["served"] == 2
+    # and the section renders (table + compare paths stay exception-free)
+    assert "service:" in trace_summary.format_table(s)
+    assert "service warm p99" in trace_summary.compare_format(s, s)
 
     p = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "runs.py"),
@@ -309,6 +331,163 @@ def test_unsafe_request_ids_and_labels_rejected(tmp_path):
     finally:
         rc, _, _ = _finish(proc, client)
     assert rc == 0
+
+
+def test_status_reports_in_flight_id_and_age(tmp_path):
+    """`op: status` carries the in-flight request's id and age, not a
+    bare 0/1 — a wedged request is attributable from the health surface
+    alone."""
+    import time as _time
+
+    out, proc, client = _start(tmp_path, "svc")
+    try:
+        busy = client.submit(
+            {"kind": "probe",
+             "cells": [{"label": "s", "op": "sleep", "sleep_s": 2.0}]},
+            wait=False,
+        )
+        _time.sleep(0.5)  # let the worker pick the sleeper up
+        status = client.status()
+        assert status["in_flight"] == 1
+        assert status["in_flight_id"] == busy["id"]
+        assert status["in_flight_age_s"] >= 0.0
+        client.wait_result(busy["id"], timeout=30)
+        idle = client.status()
+        assert idle["in_flight"] == 0 and "in_flight_id" not in idle
+    finally:
+        rc, _, _ = _finish(proc, client)
+    assert rc == 0
+
+
+def test_op_metrics_live_and_cli_one_line(tmp_path):
+    """`op: metrics` against a live server: request counters match what
+    was served, the split tiles per-request totals, probe requests
+    classify warm (no jax, no compiles) — and the `serve.py metrics`
+    subcommand keeps the one-JSON-line contract against both a live and
+    an unreachable socket."""
+    out, proc, client = _start(tmp_path, "svc")
+    try:
+        client.submit({"kind": "probe", "client": "tenant-a",
+                       "cells": [{"label": "a", "op": "ok"}]})
+        client.submit({"kind": "probe",
+                       "cells": [{"label": "b", "op": "fail"}]})
+        m = client.metrics()
+        assert m["ok"]
+        assert m["requests"]["served"] == 2
+        assert m["requests"]["quarantined"] == 1
+        assert m["requests"]["warm"] == 2  # probe cells never compile
+        assert m["cells"]["quarantined"] == 1
+        assert m["by_client"]["tenant-a"]["served"] == 1
+        assert m["by_op"]["probe"]["admitted"] == 2
+        split = m["split"]
+        assert split["total_s"] > 0
+        assert abs(
+            split["queue_wait_s"] + split["build_s"] + split["execute_s"]
+            - split["total_s"]
+        ) < 1e-4
+        assert m["latency"]["warm"]["count"] == 2
+        assert m["latency"]["warm"]["p99_s"] is not None
+
+        p = subprocess.run(
+            [sys.executable, SERVE, "metrics",
+             "--socket", socket_path_for(out)],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+        lines = [l for l in p.stdout.splitlines() if l.strip()]
+        assert len(lines) == 1 and p.returncode == 0
+        payload = json.loads(lines[0])
+        assert payload["metric"] == "service_metrics"
+        assert payload["requests"]["served"] == 2
+    finally:
+        rc, _, _ = _finish(proc, client)
+    assert rc == 0
+
+    p = subprocess.run(
+        [sys.executable, SERVE, "metrics",
+         "--socket", str(tmp_path / "nope.sock"), "--timeout", "5"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    lines = [l for l in p.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1 and p.returncode != 0
+    assert json.loads(lines[0])["ok"] is False
+
+
+def test_unsafe_client_label_rejected(tmp_path):
+    """The tenant label keys the per-client metrics tables (and may
+    become a path segment under per-tenant scheduling): an unsafe one is
+    rejected at the door like an unsafe id."""
+    out, proc, client = _start(tmp_path, "svc")
+    try:
+        reply = client.submit(
+            {"kind": "probe", "client": "../escape",
+             "cells": [{"label": "a", "op": "ok"}]},
+        )
+        assert reply["ok"] is False and "safe name" in reply["error"]
+        assert client.status()["served"] == 0
+    finally:
+        rc, _, _ = _finish(proc, client)
+    assert rc == 0
+
+
+def test_summarize_service_metrics_snapshot_fields():
+    """The sweep_status service block surfaces the latest
+    metrics_snapshot's headline numbers (queue-wait share, warm p99,
+    depth high-water mark) plus the in-flight id/age from the newest
+    health record."""
+    import sweep_status
+
+    records = [
+        {"t": "service", "event": "health", "ts": 100.0, "served": 1,
+         "queue_depth": 2, "in_flight": 1, "in_flight_id": "req-x",
+         "in_flight_age_s": 4.2},
+        {"t": "metrics_snapshot", "ts": 99.0, "uptime_s": 50.0,
+         "requests": {"warm": 3}, "queue": {"depth_hwm": 5},
+         "latency": {"warm": {"count": 3, "p99_s": 0.2}},
+         "split": {"queue_wait_share": 0.25}},
+        {"t": "metrics_snapshot", "ts": 101.0, "uptime_s": 52.0,
+         "requests": {"warm": 4}, "queue": {"depth_hwm": 6},
+         "latency": {"warm": {"count": 4, "p99_s": 0.5}},
+         "split": {"queue_wait_share": 0.4}},
+    ]
+    out = sweep_status.summarize_service(records, now=120.0)
+    assert out["in_flight_id"] == "req-x"
+    assert out["in_flight_age_s"] == 4.2
+    # the LAST snapshot stands
+    assert out["queue_wait_share"] == 0.4
+    assert out["warm_p99_s"] == 0.5 and out["warm_requests"] == 4
+    assert out["queue_depth_hwm"] == 6
+    # metrics_snapshot records count toward trace liveness
+    assert out["last_event_ts"] == 101.0
+
+
+def test_summarize_service_pending_age_trend():
+    """A wedged server's oldest-pending age GROWS across health records;
+    a draining one's shrinks — the trend field carries the sign."""
+    import sweep_status
+
+    def recs(ages):
+        return [
+            {"t": "service", "event": "health", "ts": 100.0 + i,
+             "served": 0, "oldest_pending_age_s": a}
+            for i, a in enumerate(ages)
+        ]
+
+    wedged = sweep_status.summarize_service(recs([10.0, 40.0]), now=200.0)
+    assert wedged["pending_age_trend_s"] == 30.0
+    draining = sweep_status.summarize_service(recs([40.0, 5.0]), now=200.0)
+    assert draining["pending_age_trend_s"] == -35.0
+    single = sweep_status.summarize_service(recs([10.0]), now=200.0)
+    assert "pending_age_trend_s" not in single
+    # an idle server whose NEWEST snapshot omits the age must not
+    # resurrect a stale trend from the busy past (same last-snapshot-
+    # stands discipline as oldest_pending_age_s itself)
+    idle = sweep_status.summarize_service(
+        recs([10.0, 40.0])
+        + [{"t": "service", "event": "health", "ts": 110.0, "served": 2}],
+        now=200.0,
+    )
+    assert "pending_age_trend_s" not in idle
+    assert "oldest_pending_age_s" not in idle
 
 
 def test_summarize_service_no_stale_pending_age():
@@ -374,7 +553,9 @@ def test_serve_cli_one_json_line_on_error(tmp_path):
 def test_warm_serving_zero_compiles(tmp_path):
     """A repeated identical simulate request is served entirely from the
     warm EngineCache/dataset caches: zero new XLA compiles (the
-    perf-gate pin, in-process form) and bit-identical results."""
+    perf-gate pin, in-process form), bit-identical results — and the
+    request-path accounting classifies the pair cold-then-warm with a
+    split that tiles each request's wall."""
     from blades_tpu.service.server import SimulationService
     from blades_tpu.telemetry import recorder as _trec
 
@@ -392,6 +573,30 @@ def test_warm_serving_zero_compiles(tmp_path):
     assert delta == 0
     assert second["cells"] == first["cells"]
     assert svc._engine_cache.stats()["hits"] >= 1
+    # warm/cold classification pinned on the zero-new-compiles fixture:
+    # the first request paid compiles (cold), the repeat paid none (warm)
+    m = svc.metrics.snapshot()
+    assert m["requests"]["cold"] == 1 and m["requests"]["warm"] == 1
+    assert m["latency"]["cold"]["count"] == 1
+    assert m["latency"]["warm"]["count"] == 1
+    split = m["split"]
+    assert abs(
+        split["queue_wait_s"] + split["build_s"] + split["execute_s"]
+        - split["total_s"]
+    ) < 1e-4
+    assert split["build_s"] > 0  # the cold request's trace+compile
+    # the finished request records carry the per-request split
+    recs = [json.loads(l) for l in
+            open(os.path.join(str(tmp_path / "svc"), "service_trace.jsonl"))
+            if l.strip()]
+    fin = {r["id"]: r for r in recs
+           if r.get("t") == "request" and r.get("event") == "finished"}
+    assert fin["r1"]["warm"] is False and fin["r1"]["compiles"] > 0
+    assert fin["r2"]["warm"] is True and fin["r2"]["compiles"] == 0
+    for r in fin.values():
+        assert abs(
+            r["queue_wait_s"] + r["build_s"] + r["execute_s"] - r["total_s"]
+        ) < 1e-4
 
 
 # -- perf-gate guard (fire + pass directions) ----------------------------------
@@ -426,6 +631,48 @@ def test_check_warm_serving_directions():
                                           thresholds) == []
 
 
+def test_check_warm_serving_p99_and_queue_wait_directions():
+    """The serving-path SLO gates (PR 15), both directions: warm p99
+    within service_p99_frac of baseline and queue-wait share within
+    queue_wait_share_abs pass; a synthetic p99 regression / share creep
+    / missing p99 evidence each fire; both gates stay dormant until the
+    baseline records them."""
+    import perf_report
+
+    thresholds = dict(perf_report.DEFAULT_THRESHOLDS)
+    baseline = {
+        "derived": {
+            "service_warm_cell_s": 0.06,
+            "service_warm_p99_s": 0.2,
+            "service_queue_wait_share": 0.0,
+        },
+        "rows": {},
+    }
+    good = {"warm_compiles": 0, "warm_mean_cell_s": 0.06,
+            "warm_p99_s": 0.2, "queue_wait_share": 0.05}
+    assert perf_report.check_warm_serving(good, baseline, thresholds) == []
+    # at the threshold exactly: still passing (the gate fires on >)
+    edge = dict(good, warm_p99_s=0.2 * thresholds["service_p99_frac"])
+    assert perf_report.check_warm_serving(edge, baseline, thresholds) == []
+
+    regressed = dict(good, warm_p99_s=5.0, queue_wait_share=0.6)
+    msgs = perf_report.check_warm_serving(regressed, baseline, thresholds)
+    assert len(msgs) == 2
+    assert any("warm-request p99" in m for m in msgs)
+    assert any("queue_wait_share" in m for m in msgs)
+
+    # evidence regenerated by an old script (no p99 field): the armed
+    # gate reports the hole instead of silently passing
+    stale = {"warm_compiles": 0, "warm_mean_cell_s": 0.06}
+    msgs = perf_report.check_warm_serving(stale, baseline, thresholds)
+    assert any("p99 evidence missing" in m for m in msgs)
+
+    # dormant: a baseline without the SLO keys never fires them
+    old_baseline = {"derived": {"service_warm_cell_s": 0.06}, "rows": {}}
+    assert perf_report.check_warm_serving(
+        regressed, old_baseline, thresholds) == []
+
+
 def test_committed_warm_serving_evidence_passes_gate():
     """The committed measurement (results/service/warm_serving.json) must
     satisfy the armed guard against the committed baseline."""
@@ -440,3 +687,8 @@ def test_committed_warm_serving_evidence_passes_gate():
     assert perf_report.check_warm_serving(stats, baseline, thresholds) == []
     assert baseline["derived"]["service_warm_cell_s"] == stats[
         "warm_mean_cell_s"]
+    # the serving-path SLOs are armed: the committed baseline pins the
+    # committed evidence's p99 and queue-wait share
+    assert baseline["derived"]["service_warm_p99_s"] == stats["warm_p99_s"]
+    assert baseline["derived"]["service_queue_wait_share"] == stats[
+        "queue_wait_share"]
