@@ -243,7 +243,7 @@ def check_retrace_stability(
     rec = Recorder(path=None, enabled=True)
     set_recorder(rec)
     try:
-        deltas = run_twice_with_counters(rec, run_twice)
+        deltas, marks = run_twice_with_counters(rec, run_twice)
     finally:
         set_recorder(prev if prev is not None else None)
     second = deltas[-1]
@@ -260,17 +260,40 @@ def check_retrace_stability(
                     f"jit cache grew to {cache_size()} entries for "
                     "same-shape calls" + cache_note,
                 )
+    blame = ""
+    if second != 0:
+        # compile provenance (telemetry/programs.py): name WHICH program
+        # rebuilt and WHY, not just how many compiles it cost — the
+        # registry ledger events between the two call marks are the
+        # second call's builds, each with a fingerprint + attributed
+        # cause
+        from blades_tpu.telemetry import programs as _programs
+
+        culprits = [
+            f"{e.get('program')}@{e.get('fingerprint')}"
+            f"[{e.get('cause', '?')}]"
+            for e in _programs.events()[marks[-2]:marks[-1]]
+            if e.get("outcome") != "warm-reuse"
+        ]
+        if culprits:
+            blame = "; rebuilt: " + ", ".join(culprits[:5])
     return _result(
         "retrace_stability",
         program,
         second == 0,
-        f"compiles per call: {deltas} (second call must be 0)" + cache_note,
+        f"compiles per call: {deltas} (second call must be 0)"
+        + cache_note + blame,
     )
 
 
-def run_twice_with_counters(rec, run_twice) -> List[float]:
-    """Compile-counter delta per call of the 2-call sequence."""
-    deltas = []
+def run_twice_with_counters(rec, run_twice):
+    """Compile-counter delta per call of the 2-call sequence, plus the
+    program-registry ledger index at each call boundary (so a failing
+    audit can name the program that rebuilt on the second call)."""
+    from blades_tpu.telemetry import programs as _programs
+
+    deltas: List[float] = []
+    marks = [len(_programs.events())]
 
     def snap():
         return rec.counters.get("xla.compiles", 0)
@@ -280,7 +303,8 @@ def run_twice_with_counters(rec, run_twice) -> List[float]:
         now = snap()
         deltas.append(now - before)
         before = now
-    return deltas
+        marks.append(len(_programs.events()))
+    return deltas, marks
 
 
 # -- the auditor ---------------------------------------------------------------

@@ -43,6 +43,10 @@ NO_JAX_SUFFIXES = (
     # consumed by the probe-only server and every status/metrics query
     # surface — all of which must run with the tunnel down, jax-free
     "blades_tpu/telemetry/reqpath.py",
+    # compile provenance (PR 16): the program registry must arm (register
+    # its counter observer) BEFORE the first jit, so it imports pre-jax
+    # like the recorder it observes
+    "blades_tpu/telemetry/programs.py",
     "blades_tpu/supervision/__init__.py",
     "blades_tpu/supervision/__main__.py",
     "blades_tpu/supervision/heartbeat.py",

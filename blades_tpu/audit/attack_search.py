@@ -49,6 +49,7 @@ from jax import lax
 from blades_tpu.aggregators.base import Aggregator
 from blades_tpu.attackers.base import honest_stats
 from blades_tpu.ops.distances import pairwise_sq_euclidean
+from blades_tpu.telemetry import programs as _programs
 from blades_tpu.telemetry import recorder as _trecorder
 from blades_tpu.telemetry import timeline as _timeline
 
@@ -359,7 +360,14 @@ def search_cells(
 
     if use_jit:
         run = jax.jit(run)
-    devs, rhos = run(*args)  # [C*T, 5], [C*T]
+    # compile provenance: the group's one program, under the plan_groups
+    # fingerprint when the driver passed one (run_grouped's batch key)
+    with _programs.watch(
+        f"attack_search/{type(agg).__name__}",
+        fingerprint=batch_label,
+        shapes=(n * t, k, d, has_part, ctx_keys),
+    ):
+        devs, rhos = run(*args)  # [C*T, 5], [C*T]
     devs = np.asarray(devs, np.float64).reshape(n, t, len(TEMPLATE_NAMES))
     rhos = np.asarray(rhos, np.float64).reshape(n, t)
     results = [_cell_result(devs[i], rhos[i]) for i in range(n)]

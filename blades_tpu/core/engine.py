@@ -53,6 +53,7 @@ from blades_tpu.ops.streaming import (
 )
 from blades_tpu.parallel.mesh import ShardingPlan
 from blades_tpu.telemetry import get_recorder
+from blades_tpu.telemetry import programs as _programs
 from blades_tpu.telemetry import timeline as _timeline
 from blades_tpu.telemetry.metric_pack import (
     pack_dense,
@@ -375,6 +376,11 @@ class RoundEngine:
         self._client_tx = client_opt.transform()
         self._server_tx = server_opt.transform()
         donate = (0, 1, 2) if donate_batches else (0,)
+        self._donate = donate
+        # compile-provenance identity: the Simulator stamps the EngineCache
+        # fingerprint here when one exists; the registry derives a stable
+        # fallback from label+shapes otherwise (telemetry/programs.py)
+        self.program_fingerprint: Optional[str] = None
         self._round_jit = jax.jit(self._round, donate_argnums=donate)
         self._eval_jit = jax.jit(self._eval_batch)
         self._eval_per_sample_jit = jax.jit(self._eval_batch_per_sample)
@@ -448,6 +454,14 @@ class RoundEngine:
     # -- state ---------------------------------------------------------------
 
     def init(self, params: Any, seed: int = 0) -> RoundState:
+        # compile provenance: state init dispatches eager copies/broadcast
+        # programs — build cost of this engine identity, not stray noise
+        with self._provenance(
+            "init", shapes=(self.num_clients, self.dim), donation=()
+        ):
+            return self._init(params)
+
+    def _init(self, params: Any) -> RoundState:
         # private copy: run_round donates the state's buffers back to XLA, so
         # the caller's arrays must not be aliased into it
         params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params)
@@ -1080,6 +1094,22 @@ class RoundEngine:
             {},  # async diagnostics (buffered-async body only)
         )
 
+    def _provenance(self, label: str, shapes, cause_hint=None,
+                    donation=None):
+        """Compile-provenance scope for one of this engine's programs
+        (``telemetry/programs.py``): any trace/lower/compile the bracketed
+        dispatch incurs is attributed to ``engine/<label>`` under this
+        engine's fingerprint (the EngineCache key when the Simulator
+        stamped one; a shapes-derived fallback otherwise)."""
+        fp = self.program_fingerprint
+        return _programs.watch(
+            f"engine/{label}",
+            fingerprint=f"{fp}:{label}" if fp else None,
+            shapes=shapes,
+            donation=self._donate if donation is None else donation,
+            cause_hint=cause_hint,
+        )
+
     def run_round(
         self,
         state: RoundState,
@@ -1109,7 +1139,9 @@ class RoundEngine:
         time with the compile counters joined to the launch that incurred
         them."""
         _timeline.launch_begin("round", rounds=1, attrs=self._timeline_attrs)
-        with get_recorder().span("dispatch"):
+        with get_recorder().span("dispatch"), self._provenance(
+            "round", shapes=(tuple(cx.shape), tuple(cy.shape))
+        ):
             (
                 new_state,
                 metrics,
@@ -1207,7 +1239,9 @@ class RoundEngine:
             self._block_sampler = sampler
         r = int(sample_keys.shape[0])
         _timeline.launch_begin("block", rounds=r, attrs=self._timeline_attrs)
-        with get_recorder().span("dispatch", rounds=r):
+        with get_recorder().span("dispatch", rounds=r), self._provenance(
+            "block", shapes=(r, tuple(sample_keys.shape))
+        ):
             new_state, (
                 metrics, agg_diag, fault_diag, audit_diag, mpacks, adiags,
             ) = (
@@ -1266,9 +1300,15 @@ class RoundEngine:
         Without this, the eval program's first cold build lands mid-run at
         the first validate round: the classic between-heartbeat gap under
         supervision, and a stall in the middle of a round block."""
-        xb = jnp.zeros((batch_size,) + tuple(x.shape[1:]), x.dtype)
-        yb = jnp.zeros((batch_size,), y.dtype)
-        jax.block_until_ready(self._eval_per_sample_jit(params, xb, yb))
+        with self._provenance(
+            "eval_per_sample", shapes=(tuple(x.shape[1:]), batch_size),
+            cause_hint="first-eval", donation=(),
+        ):
+            # the zeros batches live inside the scope: their (tiny) eager
+            # compiles are part of warming THIS program, not stray noise
+            xb = jnp.zeros((batch_size,) + tuple(x.shape[1:]), x.dtype)
+            yb = jnp.zeros((batch_size,), y.dtype)
+            jax.block_until_ready(self._eval_per_sample_jit(params, xb, yb))
 
     def evaluate(
         self, state: RoundState, x: jnp.ndarray, y: jnp.ndarray, batch_size: int = 512
@@ -1283,18 +1323,22 @@ class RoundEngine:
         """
         n = x.shape[0]
         tot_loss = tot_correct = tot_n = 0.0
-        for beg in range(0, n, batch_size):
-            xb = x[beg : beg + batch_size]
-            yb = y[beg : beg + batch_size]
-            pad = batch_size - xb.shape[0]
-            mask = jnp.arange(batch_size) < xb.shape[0]
-            if pad:
-                xb = jnp.pad(xb, [(0, pad)] + [(0, 0)] * (xb.ndim - 1))
-                yb = jnp.pad(yb, [(0, pad)])
-            l, c, m = self._eval_jit(state.params, xb, yb, mask)
-            tot_loss += float(l)
-            tot_correct += float(c)
-            tot_n += float(m)
+        with self._provenance(
+            "eval", shapes=(tuple(x.shape[1:]), batch_size),
+            cause_hint="first-eval", donation=(),
+        ):
+            for beg in range(0, n, batch_size):
+                xb = x[beg : beg + batch_size]
+                yb = y[beg : beg + batch_size]
+                pad = batch_size - xb.shape[0]
+                mask = jnp.arange(batch_size) < xb.shape[0]
+                if pad:
+                    xb = jnp.pad(xb, [(0, pad)] + [(0, 0)] * (xb.ndim - 1))
+                    yb = jnp.pad(yb, [(0, pad)])
+                l, c, m = self._eval_jit(state.params, xb, yb, mask)
+                tot_loss += float(l)
+                tot_correct += float(c)
+                tot_n += float(m)
         return {"Loss": tot_loss / tot_n, "top1": tot_correct / tot_n}
 
     def evaluate_per_sample(
@@ -1306,16 +1350,24 @@ class RoundEngine:
 
         n = x.shape[0]
         losses, correct = [], []
-        for beg in range(0, n, batch_size):
-            xb = x[beg : beg + batch_size]
-            yb = y[beg : beg + batch_size]
-            pad = batch_size - xb.shape[0]
-            if pad:
-                xb = jnp.pad(xb, [(0, pad)] + [(0, 0)] * (xb.ndim - 1))
-                yb = jnp.pad(yb, [(0, pad)])
-            l, c = self._eval_per_sample_jit(state.params, xb, yb)
-            losses.append(np.asarray(l)[: batch_size - pad if pad else batch_size])
-            correct.append(np.asarray(c)[: batch_size - pad if pad else batch_size])
+        with self._provenance(
+            "eval_per_sample", shapes=(tuple(x.shape[1:]), batch_size),
+            cause_hint="first-eval", donation=(),
+        ):
+            for beg in range(0, n, batch_size):
+                xb = x[beg : beg + batch_size]
+                yb = y[beg : beg + batch_size]
+                pad = batch_size - xb.shape[0]
+                if pad:
+                    xb = jnp.pad(xb, [(0, pad)] + [(0, 0)] * (xb.ndim - 1))
+                    yb = jnp.pad(yb, [(0, pad)])
+                l, c = self._eval_per_sample_jit(state.params, xb, yb)
+                losses.append(
+                    np.asarray(l)[: batch_size - pad if pad else batch_size]
+                )
+                correct.append(
+                    np.asarray(c)[: batch_size - pad if pad else batch_size]
+                )
         return np.concatenate(losses), np.concatenate(correct)
 
 

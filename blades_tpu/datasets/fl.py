@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from blades_tpu.telemetry import programs as _programs
+
 
 class FLDataset:
     """Device-resident federated dataset.
@@ -225,7 +227,11 @@ class FLDataset:
             self._sample_jit[sig] = jax.jit(
                 self._make_sample_fn(local_steps, batch_size)
             )
-        return self._sample_jit[sig](key)
+        with _programs.watch(
+            "dataset/sample_round",
+            shapes=(self.num_clients, local_steps, batch_size),
+        ):
+            return self._sample_jit[sig](key)
 
     def get_train_data(
         self, u_id: int, num_batches: int, batch_size: int = 32,

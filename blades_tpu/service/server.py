@@ -345,6 +345,13 @@ class SimulationService:
         # share / warm p99 are queryable from the trace of a LIVE (or
         # dead) server, not just over the socket
         self.event("metrics_snapshot", **self.metrics.snapshot())
+        # compile provenance: the warm-engine cache's per-fingerprint
+        # stats ride the same beat (hits/misses/build cost/last-used per
+        # EngineCache key — the affinity signal a warm-first scheduler
+        # orders by); no record until the first simulate request builds
+        # the cache
+        if self._engine_cache is not None:
+            self.event("cache_stats", **self._engine_cache.stats())
         self._last_health = time.monotonic()
 
     # -- listener --------------------------------------------------------------
@@ -405,9 +412,13 @@ class SimulationService:
         elif op == "status":
             self._reply_and_close(f, conn, {"ok": True, **self._snapshot()})
         elif op == "metrics":
-            self._reply_and_close(
-                f, conn, {"ok": True, **self.metrics.snapshot()}
-            )
+            reply = {"ok": True, **self.metrics.snapshot()}
+            if self._engine_cache is not None:
+                # per-fingerprint warm-cache stats (PR 16 compile
+                # provenance): live over the socket, same dict the
+                # `cache_stats` trace records flush each health beat
+                reply["engine_cache"] = self._engine_cache.stats()
+            self._reply_and_close(f, conn, reply)
         elif op == "result":
             rid = str(msg.get("id") or "")
             reply = self.spool.reply(rid)

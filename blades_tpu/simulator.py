@@ -48,6 +48,7 @@ from blades_tpu.telemetry import timeline as _timeline
 from blades_tpu.telemetry import context as _context
 from blades_tpu.telemetry import ledger as _ledger
 from blades_tpu.telemetry import profiling as _profiling
+from blades_tpu.telemetry import programs as _programs
 from blades_tpu.telemetry.metric_pack import pack_to_fields
 from blades_tpu.utils.checkpoint import checkpoint_file, restore_state, save_state
 from blades_tpu.utils.logging import initialize_logger
@@ -575,15 +576,23 @@ class Simulator:
         # handlers, so it needs its own terminal-ledger protection —
         # a run killed mid-compile must not stay 'open' forever
         try:
-            spec = self._model_spec(model, loss, compute_dtype)
-            batch_size = train_batch_size or self._train_bs
+            # compile provenance: model-spec build + param init dispatch a
+            # long tail of tiny eager-op compiles — attribute them to one
+            # "model init" program instead of the unattributed bucket
+            # (they are real build cost the tiling invariant must cover)
+            with _programs.watch(
+                f"model/{model if isinstance(model, str) else 'custom'}/init",
+                shapes=tuple(self.dataset.train_x.shape[2:]),
+            ):
+                spec = self._model_spec(model, loss, compute_dtype)
+                batch_size = train_batch_size or self._train_bs
 
-            key = jax.random.PRNGKey(self.seed)
-            params = spec.init(jax.random.fold_in(key, 17))
+                key = jax.random.PRNGKey(self.seed)
+                params = spec.init(jax.random.fold_in(key, 17))
 
-            trusted = jnp.asarray(
-                [c.is_trusted() for c in self.get_clients()], dtype=bool
-            )
+                trusted = jnp.asarray(
+                    [c.is_trusted() for c in self.get_clients()], dtype=bool
+                )
             attack = self.attack
             if self._custom_attack_entries:
                 attack = _CompositeAttack(self._custom_attack_entries)
@@ -677,14 +686,32 @@ class Simulator:
                 self.engine.fault_model = fault_model
                 rec.event("engine_cache", hit=1, key=engine_key)
             else:
-                self.engine = RoundEngine(
-                    spec.train_loss_fn,
-                    spec.eval_logits_fn,
-                    params,
-                    **engine_kwargs,
-                )
+                t_build = time.perf_counter()
+                # compile provenance: constructor-time eager dispatches
+                # (unravel builders, mask precomputation) are build cost
+                # of THIS engine identity, not unattributed noise
+                with _programs.watch(
+                    "engine/construct",
+                    fingerprint=(
+                        f"{engine_key}:construct" if engine_key else None
+                    ),
+                ):
+                    self.engine = RoundEngine(
+                        spec.train_loss_fn,
+                        spec.eval_logits_fn,
+                        params,
+                        **engine_kwargs,
+                    )
+                # compile provenance (telemetry/programs.py): the engine's
+                # programs share the EngineCache fingerprint dialect, so a
+                # `program` record and a `cache_stats` entry name the same
+                # identity
+                self.engine.program_fingerprint = engine_key
                 if engine_key is not None:
-                    engine_cache.put(engine_key, self.engine)
+                    engine_cache.put(
+                        engine_key, self.engine,
+                        build_s=time.perf_counter() - t_build,
+                    )
             # memory observability: the round program's peak update-matrix
             # footprint rides every round record as gauges (streaming rounds
             # must show [chunk, D], dense rounds [K, D] — trace_summary.py
@@ -877,7 +904,8 @@ class Simulator:
                             # round program (cache-hit compile; `memory`
                             # record next to the analytical
                             # engine.peak_update_bytes gauge)
-                            with rec.span("program_profile"):
+                            with rec.span("program_profile"), \
+                                    _programs.watch("profiling/round"):
                                 _profiling.record_program_profile(
                                     "round", self.engine._round_jit,
                                     state, cx, cy,

@@ -46,6 +46,7 @@ import hashlib
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from blades_tpu.telemetry import programs as _programs
 from blades_tpu.telemetry.ledger import config_fingerprint
 
 __all__ = [
@@ -345,30 +346,78 @@ class EngineCache:
     with it). A hit means the compiled round/eval programs are already
     warm — the chaos twin/rerun scenarios' whole trace+compile cost
     becomes one dict lookup. Hit/miss counters feed the sweep summary so
-    the amortization is a reported number, not an assumption."""
+    the amortization is a reported number, not an assumption.
 
-    def __init__(self):
+    PR 16: per-fingerprint stats (hits, misses, build cost, last-used)
+    back the ``cache_stats`` records the simulation service flushes each
+    health beat and serves via ``serve.py metrics`` — the fingerprint-
+    affinity signal ROADMAP item 2's warm-first scheduler orders by. An
+    optional ``max_entries`` bound evicts least-recently-used entries and
+    reports the eviction to the compile-provenance registry
+    (``telemetry/programs.py``), so the evicted program's NEXT build is
+    attributed ``cache-eviction`` instead of looking like a new program.
+    """
+
+    def __init__(self, max_entries: Optional[int] = None):
         self._entries: Dict[str, Any] = {}
+        self._stats: Dict[str, Dict[str, Any]] = {}
+        # LRU order by a monotonic use sequence, NOT last_used: the
+        # reported wall timestamp is rounded to 1 ms and same-millisecond
+        # touches would make eviction order arbitrary
+        self._order: Dict[str, int] = {}
+        self._seq = 0
+        self.max_entries = max_entries
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+
+    def _key_stats(self, key: str) -> Dict[str, Any]:
+        return self._stats.setdefault(
+            key, {"hits": 0, "misses": 0, "build_s": None, "last_used": None}
+        )
 
     def get(self, key: str) -> Any:
         value = self._entries.get(key)
+        ks = self._key_stats(key)
+        ks["last_used"] = round(time.time(), 3)
+        self._seq += 1
+        self._order[key] = self._seq
         if value is None:
             self.misses += 1
+            ks["misses"] += 1
         else:
             self.hits += 1
+            ks["hits"] += 1
         return value
 
-    def put(self, key: str, value: Any) -> None:
+    def put(self, key: str, value: Any, build_s: Optional[float] = None) -> None:
         self._entries[key] = value
+        ks = self._key_stats(key)
+        ks["last_used"] = round(time.time(), 3)
+        self._seq += 1
+        self._order[key] = self._seq
+        if build_s is not None:
+            ks["build_s"] = round(float(build_s), 6)
+        if self.max_entries is not None and len(self._entries) > self.max_entries:
+            # LRU eviction (never the key just inserted): report it so the
+            # provenance registry can attribute the rebuild
+            victims = sorted(
+                (k for k in self._entries if k != key),
+                key=lambda k: self._order.get(k, 0),
+            )
+            for victim in victims[: len(self._entries) - self.max_entries]:
+                del self._entries[victim]
+                self.evictions += 1
+                _programs.note_eviction(victim)
 
     def __len__(self) -> int:
         return len(self._entries)
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, Any]:
         return {
             "entries": len(self._entries),
             "hits": self.hits,
             "misses": self.misses,
+            "evictions": self.evictions,
+            "by_key": {k: dict(v) for k, v in self._stats.items()},
         }

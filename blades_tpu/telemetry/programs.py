@@ -1,0 +1,367 @@
+"""Compile provenance: attribute every trace/lower/compile to a program.
+
+The repo's dominant, least-attributed cost is program building: the
+persistent XLA cache absorbs backend compiles but NOT single-core
+trace/lowering (CLAUDE.md — it dominates the 17-min tier-1 run), and the
+pre-PR-12 certify sweep was ~81% trace+compile. Telemetry so far carries
+only *process-wide* compile counters (``xla.compiles``/``xla.trace_s``,
+the ``recorder.process_counters()`` mirror): no record says WHICH program
+compiled, WHY, or what it cost. This module is that ledger — the
+substrate ROADMAP item 2's warm-first scheduler needs ("orders the queue
+by EngineCache fingerprint affinity").
+
+**How attribution works.** ``recorder.install_jax_monitoring()`` already
+mirrors every jax.monitoring compile/cache event into the process-wide
+counter dict; this module registers a counter *observer*
+(:func:`recorder.add_counter_observer`) so each increment is ALSO routed
+to the innermost open :func:`watch` scope on the current thread. Every
+jitted entry point (engine round/eval/block programs, batched sweep
+programs, dataset samplers) brackets its dispatch in
+``with programs.watch(label, fingerprint=..., shapes=..., donation=...)``;
+jax fires its trace/lower/compile events synchronously on the calling
+thread, so the scope collects exactly that launch's build cost. Events
+with NO open scope fold into an ``unattributed`` bucket, which makes the
+tiling invariant *measurable*: per-program seconds + unattributed
+seconds == the process-wide ``xla.*`` mirror, and the attributed share
+must stay ≥ 95% on a certify-style sweep
+(``tests/test_programs.py::test_tiling_invariant``).
+
+**What a close emits.** A scope close classifies its cache outcome —
+
+- ``cold``: at least one backend compile ran (``xla.compiles`` > 0);
+- ``persistent-cache-hit``: traced/lowered but the executable came from
+  the persistent XLA cache (or jax's in-process cache) — the single-core
+  cost the persistent cache does NOT absorb;
+- ``warm-reuse``: no build events at all (the jit dispatch reused a
+  live executable);
+
+— and, for any build, an attributed **cause**:
+
+- ``cache-eviction``: this (fingerprint, shapes) was built before in
+  this process, or the fingerprint was explicitly evicted
+  (:func:`note_eviction`, wired to ``EngineCache``);
+- ``first-eval`` (or any caller hint): the call site knows why the first
+  build happens (``RoundEngine.warm_eval``);
+- ``shape-change`` / ``donation-change``: the label was seen before with
+  different abstract shapes / donation config;
+- ``new-fingerprint``: first sighting of the label.
+
+Builds emit one schema-v7 ``program`` record each onto the ACTIVE
+recorder (same routing as ``timeline.sweep_cell_event`` — the record
+lands in whatever trace owns the launch); warm-reuse closes emit at most
+ONE record per (fingerprint, label) so the outcome taxonomy is
+observable without per-round spam — a warm service repeat request emits
+ZERO build records by construction, which is exactly what
+``perf_report.py --check`` gates (the zero-unexplained-recompiles gate).
+Every emitted record is also kept in a bounded in-process ledger
+(:func:`events`), independent of recorder swaps, so
+``scripts/service_baseline.py`` and the Tier-B retrace audit can ask
+"what built during THIS window, and why".
+
+Like the rest of the recorder stack this module is stdlib-only and
+importable before jax (IMP001-contracted, pinned by the analysis
+Tier-A rule set), so the registry can arm before the first jit. A scope
+close is dict arithmetic; with telemetry disabled nothing is emitted and
+no clock is read outside the rare build path.
+
+Record schema: ``docs/telemetry_schema.json`` v7 (``program``); prose in
+``docs/observability.md`` "Compile provenance".
+Reference counterpart: none — the reference has no compile accounting at
+all (``src/blades/simulator.py:453-455`` records whole-round wall only).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from blades_tpu.telemetry import recorder as _recorder
+
+#: process-counter name -> field name in scopes / emitted records
+_SCOPE_FIELDS = {
+    "xla.trace_s": "trace_s",
+    "xla.lower_s": "lower_s",
+    "xla.compile_s": "compile_s",
+    "xla.compiles": "compiles",
+    "xla.cache_hits": "cache_hits",
+    "xla.cache_misses": "cache_misses",
+}
+
+_INT_FIELDS = frozenset({"compiles", "cache_hits", "cache_misses"})
+
+#: the seconds that must tile the process-wide mirror
+SECONDS_FIELDS = ("trace_s", "lower_s", "compile_s")
+
+CAUSES = (
+    "new-fingerprint",
+    "shape-change",
+    "donation-change",
+    "cache-eviction",
+    "first-eval",
+)
+OUTCOMES = ("cold", "persistent-cache-hit", "warm-reuse")
+
+#: bounded ledger of emitted records (oldest dropped first — like the
+#: recorder's max_buffer, bound the memory, never the run)
+_MAX_EVENTS = 4096
+
+_lock = threading.RLock()
+_tls = threading.local()
+
+# -- registry state (all guarded by _lock except the thread-local stack) -------
+_attributed: Dict[str, float] = {}
+_unattributed: Dict[str, float] = {}
+_label_shapes: Dict[str, str] = {}
+_label_donation: Dict[str, str] = {}
+_built_keys: set = set()      # (fingerprint, shapes_key) built before
+_evicted: set = set()         # fingerprints evicted from a warm cache
+_warm_emitted: set = set()    # (fingerprint, label) warm record already out
+_programs: Dict[str, Dict[str, Any]] = {}
+_events: List[Dict[str, Any]] = []
+_events_dropped = 0
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _key_str(value: Any) -> str:
+    if value is None:
+        return ""
+    if isinstance(value, str):
+        return value
+    return repr(value)
+
+
+def derive_fingerprint(
+    label: str, shapes: Any = None, donation: Any = None
+) -> str:
+    """Stable fallback fingerprint for call sites with no ``EngineCache``
+    key in scope: sha256 over (label, shapes, donation), truncated like
+    ``sweeps.config_fingerprint`` output."""
+    basis = "|".join((str(label), _key_str(shapes), _key_str(donation)))
+    return hashlib.sha256(basis.encode()).hexdigest()[:12]
+
+
+def _observe(name: str, inc: float) -> None:
+    """Counter observer: route one process-counter increment to the
+    innermost open scope on this thread, or the unattributed bucket."""
+    field = _SCOPE_FIELDS.get(name)
+    if field is None:
+        return
+    st = getattr(_tls, "stack", None)
+    if st:
+        counts = st[-1].counts
+        counts[field] = counts.get(field, 0) + inc
+        bucket = _attributed
+    else:
+        bucket = _unattributed
+    with _lock:
+        bucket[field] = bucket.get(field, 0) + inc
+
+
+class _Watch:
+    """One open program scope (a bracketed jit dispatch)."""
+
+    __slots__ = (
+        "label", "fingerprint", "shapes_key", "donation_key", "cause_hint",
+        "counts",
+    )
+
+    def __init__(self, label, fingerprint, shapes, donation, cause_hint):
+        self.label = str(label)
+        self.fingerprint = fingerprint
+        self.shapes_key = _key_str(shapes)
+        self.donation_key = _key_str(donation)
+        self.cause_hint = cause_hint
+        self.counts: Dict[str, float] = {}
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        elif self in st:  # defensive: a mis-nested close must not wedge
+            st.remove(self)
+        try:
+            _close(self)
+        except Exception:  # noqa: BLE001 - provenance must never kill a run
+            pass
+        return False
+
+
+def watch(
+    label: str,
+    *,
+    fingerprint: Optional[str] = None,
+    shapes: Any = None,
+    donation: Any = None,
+    cause_hint: Optional[str] = None,
+) -> _Watch:
+    """Bracket one jit dispatch: ``with programs.watch("engine/round",
+    fingerprint=fp, shapes=(cx.shape, cy.shape), donation=(0, 1, 2)):``.
+
+    ``fingerprint`` is the program's cache identity (the EngineCache key
+    dialect where one exists; derived from label+shapes+donation
+    otherwise); ``shapes`` / ``donation`` may be any stable-repr value —
+    they feed the shape-change / donation-change cause attribution;
+    ``cause_hint`` names a build cause the call site knows a priori
+    (``"first-eval"``). Nesting attributes events to the INNERMOST open
+    scope (an outer experiment-batch scope does not steal its inner
+    cells' builds).
+    """
+    return _Watch(label, fingerprint, shapes, donation, cause_hint)
+
+
+def _classify_cause(scope: _Watch, fp: str) -> str:
+    if (fp, scope.shapes_key) in _built_keys or fp in _evicted:
+        return "cache-eviction"
+    if scope.label not in _label_shapes:
+        return scope.cause_hint or "new-fingerprint"
+    if _label_shapes[scope.label] != scope.shapes_key:
+        return "shape-change"
+    if _label_donation.get(scope.label) != scope.donation_key:
+        return "donation-change"
+    return scope.cause_hint or "new-fingerprint"
+
+
+def _close(scope: _Watch) -> None:
+    global _events_dropped
+    c = scope.counts
+    if c.get("compiles"):
+        outcome = "cold"
+    elif any(c.get(f) for f in _SCOPE_FIELDS.values()):
+        outcome = "persistent-cache-hit"
+    else:
+        outcome = "warm-reuse"
+    fp = scope.fingerprint or derive_fingerprint(
+        scope.label, scope.shapes_key, scope.donation_key
+    )
+    with _lock:
+        cause = None
+        if outcome != "warm-reuse":
+            cause = _classify_cause(scope, fp)
+            _built_keys.add((fp, scope.shapes_key))
+            _evicted.discard(fp)
+        _label_shapes[scope.label] = scope.shapes_key
+        _label_donation[scope.label] = scope.donation_key
+        entry = _programs.setdefault(
+            fp,
+            {
+                "program": scope.label,
+                "builds": 0,
+                "warm": 0,
+                "trace_s": 0.0,
+                "lower_s": 0.0,
+                "compile_s": 0.0,
+                "compiles": 0,
+            },
+        )
+        if outcome == "warm-reuse":
+            entry["warm"] += 1
+        else:
+            entry["builds"] += 1
+            entry["last_cause"] = cause
+            for f in SECONDS_FIELDS:
+                entry[f] = round(entry[f] + c.get(f, 0.0), 6)
+            entry["compiles"] += int(c.get("compiles", 0))
+        entry["last_outcome"] = outcome
+        if outcome == "warm-reuse":
+            wkey = (fp, scope.label)
+            if wkey in _warm_emitted:
+                return
+            _warm_emitted.add(wkey)
+        record: Dict[str, Any] = {
+            "program": scope.label,
+            "fingerprint": fp,
+            "outcome": outcome,
+            "ts": time.time(),
+        }
+        if cause is not None:
+            record["cause"] = cause
+        if scope.shapes_key:
+            record["shapes"] = scope.shapes_key
+        if scope.donation_key:
+            record["donation"] = scope.donation_key
+        for f in _SCOPE_FIELDS.values():
+            v = c.get(f)
+            if v:
+                record[f] = int(v) if f in _INT_FIELDS else round(v, 6)
+        _events.append(record)
+        if len(_events) > _MAX_EVENTS:
+            excess = len(_events) - _MAX_EVENTS // 2
+            del _events[:excess]
+            _events_dropped += excess
+    rec = _recorder.get_recorder()
+    if rec.enabled:
+        rec.event("program", **record)
+
+
+def note_eviction(fingerprint: str) -> None:
+    """Mark ``fingerprint`` evicted from a warm cache (``EngineCache``):
+    its next build is attributed ``cache-eviction``, not a new program."""
+    with _lock:
+        _evicted.add(str(fingerprint))
+
+
+def events() -> List[Dict[str, Any]]:
+    """The bounded in-process ledger of emitted ``program`` records, in
+    emission order — independent of recorder swaps. Callers snapshot
+    ``len(events())`` before a window and slice after
+    (``scripts/service_baseline.py``'s warm-phase gate)."""
+    with _lock:
+        return [dict(e) for e in _events]
+
+
+def snapshot() -> Dict[str, Any]:
+    """Registry rollup: attributed vs unattributed counter totals, the
+    attributed coverage share of build seconds (the tiling invariant's
+    measured quantity), and per-fingerprint aggregates."""
+    with _lock:
+        attr = dict(_attributed)
+        unattr = dict(_unattributed)
+        progs = {fp: dict(v) for fp, v in _programs.items()}
+        emitted = len(_events)
+        dropped = _events_dropped
+    attr_s = sum(attr.get(f, 0.0) for f in SECONDS_FIELDS)
+    total_s = attr_s + sum(unattr.get(f, 0.0) for f in SECONDS_FIELDS)
+    return {
+        "attributed": attr,
+        "unattributed": unattr,
+        "coverage": round(attr_s / total_s, 6) if total_s else 1.0,
+        "programs": progs,
+        "emitted": emitted,
+        "dropped": dropped,
+    }
+
+
+def reset() -> None:
+    """Drop ALL registry state (tests; a fresh measurement window). Only
+    the calling thread's scope stack is cleared — other threads' open
+    scopes keep accumulating into their own (new) entries."""
+    global _events_dropped
+    with _lock:
+        _attributed.clear()
+        _unattributed.clear()
+        _label_shapes.clear()
+        _label_donation.clear()
+        _built_keys.clear()
+        _evicted.clear()
+        _warm_emitted.clear()
+        _programs.clear()
+        del _events[:]
+        _events_dropped = 0
+    _tls.stack = []
+
+
+# arm at import: the observer is pure dict arithmetic and fires only on
+# (rare) jax.monitoring events, so registering unconditionally is free
+_recorder.add_counter_observer(_observe)

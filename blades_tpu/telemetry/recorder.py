@@ -323,10 +323,36 @@ def process_counters() -> Dict[str, float]:
     since :func:`install_jax_monitoring`; empty before it)."""
     return dict(_PROCESS_COUNTERS)
 
+
+#: Counter observers: called as ``fn(name, inc)`` on EVERY process-counter
+#: update, right after the mirror — the compile-provenance registry
+#: (``telemetry/programs.py``) routes increments to the innermost open
+#: program scope this way. Same contract as the mirror itself: fires
+#: regardless of which Recorder is active, pure python, and a broken
+#: observer never takes down the run.
+_counter_observers: list = []
+
+
+def add_counter_observer(fn: Callable[[str, float], None]) -> None:
+    """Register ``fn(counter_name, inc)`` on the process-counter feed
+    (idempotent per function object — module reloads must not double)."""
+    if fn not in _counter_observers:
+        _counter_observers.append(fn)
+
+
+def _notify_observers(name: str, inc: float) -> None:
+    for fn in _counter_observers:
+        try:
+            fn(name, inc)
+        except Exception:  # noqa: BLE001 - observability must not raise
+            pass
+
+
 # jax.monitoring duration event -> (count counter | None, seconds counter)
 _JAX_DURATION_COUNTERS = {
     "/jax/core/compile/backend_compile_duration": ("xla.compiles", "xla.compile_s"),
     "/jax/core/compile/jaxpr_trace_duration": (None, "xla.trace_s"),
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": (None, "xla.lower_s"),
     "/jax/compilation_cache/compile_time_saved_sec": (None, "xla.compile_saved_s"),
     "/jax/compilation_cache/cache_retrieval_time_sec": (None, "xla.cache_retrieval_s"),
 }
@@ -355,6 +381,7 @@ def install_jax_monitoring() -> bool:
         name = _JAX_EVENT_COUNTERS.get(event)
         if name is not None:
             _PROCESS_COUNTERS[name] = _PROCESS_COUNTERS.get(name, 0) + 1
+            _notify_observers(name, 1)
             get_recorder().counter(name)
 
     def _on_duration(event: str, duration: float, **kw) -> None:
@@ -366,9 +393,11 @@ def install_jax_monitoring() -> bool:
             _PROCESS_COUNTERS[count_name] = (
                 _PROCESS_COUNTERS.get(count_name, 0) + 1
             )
+            _notify_observers(count_name, 1)
         _PROCESS_COUNTERS[secs_name] = (
             _PROCESS_COUNTERS.get(secs_name, 0) + duration
         )
+        _notify_observers(secs_name, duration)
         rec = get_recorder()
         if not rec.enabled:
             return

@@ -16,7 +16,10 @@ runs (``blades_tpu/service``) get a ``service_health`` block the same
 way — queue depth, the in-flight request's id + age, served/rejected/
 quarantined counts, oldest-pending age + trend, and (from the latest
 ``metrics_snapshot`` record, ``telemetry/reqpath.py``) queue-wait
-share and warm-request p99.
+share and warm-request p99. Any run whose trace carries schema-v7
+``program`` records (``telemetry/programs.py``) additionally gets a
+``programs`` block — cold-vs-warm program split and the top-3
+compile-cost programs.
 With ``--tunnel`` it additionally summarizes the TPU tunnel probe log
 (``results/tpu_r5/tunnel_probes.jsonl``, written by
 ``scripts/tpu_capture.py``) into availability windows — up fraction,
@@ -240,6 +243,24 @@ def service_health(
     return summarize_service(records)
 
 
+def program_costs(
+    trail: List[Dict[str, Any]], repo: str = REPO,
+    records: Optional[List[Dict[str, Any]]] = None,
+) -> Optional[Dict[str, Any]]:
+    """Compile-provenance rollup for a run's attempt trail, from the
+    schema-v7 ``program`` records in its registered trace artifacts
+    (``telemetry/programs.py``): cold-vs-warm program split + the top-3
+    compile-cost programs, next to the wall/compile/execute columns the
+    sweep summarizer already reports. Same rollup as
+    ``sweep_status.summarize_programs``; ``None`` for pre-v7 traces."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from sweep_status import summarize_programs
+
+    if records is None:
+        records = artifact_records(trail, repo)
+    return summarize_programs(records)
+
+
 def summarize_tunnel(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Availability windows from timestamped up/down probe records.
 
@@ -359,6 +380,12 @@ def _run(argv: Optional[List[str]] = None) -> int:
         health = service_health(trail, records=records_art)
         if health is not None:
             payload["service_health"] = health
+        # compile provenance (telemetry/programs.py): which programs this
+        # run built, what they cost, and the cold-vs-warm split — a
+        # recompiling run is distinguishable from a warm one here too
+        programs = program_costs(trail, records=records_art)
+        if programs is not None:
+            payload["programs"] = programs
     else:
         payload["latest"] = latest_rows(paired, args.latest)
 

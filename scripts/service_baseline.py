@@ -27,8 +27,11 @@ pins: ``warm_compiles == 0``, warm per-cell build overhead at or under
 the committed batched-sweep per-cell overhead
 (``dispatch/cert_slice_batched``), warm per-cell wall within threshold
 of its own committed baseline, warm-request p99 within
-``service_p99_frac`` of baseline, and queue-wait share within
-``queue_wait_share_abs`` absolute of baseline.
+``service_p99_frac`` of baseline, queue-wait share within
+``queue_wait_share_abs`` absolute of baseline, and — compile provenance
+(``telemetry/programs.py``) — ``warm_program_builds == 0``: the warm
+window must emit zero cold-outcome ``program`` records (any compile is
+named with its fingerprint + attributed cause in the gate message).
 
 Usage::
 
@@ -67,6 +70,7 @@ WARM_REPEATS = 12
 def measure(aggs=AGGS, rounds: int = 2, warm_repeats: int = WARM_REPEATS) -> dict:
     from blades_tpu.service.server import SimulationService
     from blades_tpu.telemetry import context as _context
+    from blades_tpu.telemetry import programs as _programs
     from blades_tpu.telemetry import recorder as _trecorder
     from blades_tpu.utils.platform import force_virtual_cpu
 
@@ -115,6 +119,11 @@ def measure(aggs=AGGS, rounds: int = 2, warm_repeats: int = WARM_REPEATS) -> dic
         }
 
     cold = one("warmup-cold")
+    # compile provenance (telemetry/programs.py): everything the warm
+    # window builds is a gate violation — snapshot the in-process
+    # registry ledger here and diff after the ladder. Build-outcome
+    # records only: warm-reuse closes are the expected steady state.
+    prov_before = len(_programs.events())
     warm = one("warmup-warm")
     ref_cells = cold.pop("cells")
     identical = ref_cells == warm.pop("cells")
@@ -128,6 +137,17 @@ def measure(aggs=AGGS, rounds: int = 2, warm_repeats: int = WARM_REPEATS) -> dic
     metrics = svc.metrics.snapshot()
     warm_lat = (metrics.get("latency") or {}).get("warm") or {}
     split = metrics.get("split") or {}
+    # cold records only: a warm repeat may legally re-trace a tiny eager
+    # op (outcome persistent-cache-hit, no backend compile) — the gate
+    # pins UNEXPLAINED COMPILES, the ISSUE's "no cold-cause records"
+    warm_window = [
+        e for e in _programs.events()[prov_before:]
+        if e.get("outcome") == "cold"
+    ]
+    warm_program_builds = len(warm_window)
+    warm_programs_built = [
+        f"{e.get('program')}[{e.get('cause')}]" for e in warm_window[:5]
+    ]
     return {
         "metric": METRIC,
         "cells": len(aggs),
@@ -138,6 +158,11 @@ def measure(aggs=AGGS, rounds: int = 2, warm_repeats: int = WARM_REPEATS) -> dic
         "warm_mean_cell_s": warm["mean_cell_s"],
         "warm_compiles": warm["compiles"],
         "warm_per_cell_overhead_s": warm["per_cell_overhead_s"],
+        # compile-provenance pin (telemetry/programs.py): build-outcome
+        # program records emitted during the whole warm window (first
+        # warm request + repeat ladder) — perf_report pins this to 0
+        "warm_program_builds": warm_program_builds,
+        "warm_programs_built": warm_programs_built,
         "speedup": round(cold["wall_s"] / max(warm["wall_s"], 1e-9), 1),
         # serving-path SLO numbers (telemetry/reqpath.py): warm-request
         # p99 over full admission-to-reply walls, and the queue-wait
@@ -155,6 +180,7 @@ def measure(aggs=AGGS, rounds: int = 2, warm_repeats: int = WARM_REPEATS) -> dic
         "ok": bool(
             identical
             and warm["compiles"] == 0
+            and warm_program_builds == 0
             and warm_lat.get("p99_s") is not None
             and metrics["requests"]["cold"] == 1
         ),

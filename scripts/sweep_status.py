@@ -186,6 +186,49 @@ def summarize_sweeps(
     return summary
 
 
+def summarize_programs(
+    records: List[Dict[str, Any]], top: int = 3
+) -> Optional[Dict[str, Any]]:
+    """Compile-provenance rollup from ``program`` records
+    (``telemetry/programs.py``, schema v7): the cold-vs-warm program
+    split plus the top-``top`` build-cost programs — next to the existing
+    wall/compile/execute columns, this says WHICH programs a slow sweep
+    is paying for, and whether a "warm" relaunch actually rebuilt
+    anything. ``None`` when the trace predates provenance (older
+    committed traces — every consumer degrades to the old report)."""
+    progs = [r for r in records if r.get("t") == "program"]
+    if not progs:
+        return None
+    by_fp: Dict[str, Dict[str, Any]] = {}
+    cold = warm = 0
+    for r in progs:
+        fp = r.get("fingerprint", "?")
+        e = by_fp.setdefault(
+            fp,
+            {"program": r.get("program", "?"), "fingerprint": fp,
+             "builds": 0, "build_s": 0.0, "causes": {}},
+        )
+        if r.get("outcome") == "warm-reuse":
+            warm += 1
+            continue
+        cold += 1
+        e["builds"] += 1
+        cause = r.get("cause", "?")
+        e["causes"][cause] = e["causes"].get(cause, 0) + 1
+        e["build_s"] = round(
+            e["build_s"] + r.get("trace_s", 0.0) + r.get("lower_s", 0.0)
+            + r.get("compile_s", 0.0), 6,
+        )
+    ranked = sorted(by_fp.values(), key=lambda e: -e["build_s"])
+    return {
+        "programs": len(by_fp),
+        "built": cold,
+        "warm_reuse": warm,
+        "build_s": round(sum(e["build_s"] for e in by_fp.values()), 3),
+        "top": ranked[:top],
+    }
+
+
 def summarize_service(
     records: List[Dict[str, Any]], now: Optional[float] = None
 ) -> Optional[Dict[str, Any]]:
@@ -317,6 +360,9 @@ def _run(argv: Optional[List[str]] = None) -> int:
     service = summarize_service(records)
     if service is not None:
         payload["service"] = service
+    programs = summarize_programs(records)
+    if programs is not None:
+        payload["programs"] = programs
     print(json.dumps(payload))
     return 0
 

@@ -66,6 +66,8 @@ def summarize(records: List[dict]) -> dict:
     request_events = []
     metrics_snapshots = []
     programs = []
+    prov_records = []
+    cache_stats = []
     profile_events = []
     margins = []
     alerts = []
@@ -115,6 +117,10 @@ def summarize(records: List[dict]) -> dict:
             asyncs.append(r)
         elif t == "memory":
             programs.append(r)
+        elif t == "program":
+            prov_records.append(r)
+        elif t == "cache_stats":
+            cache_stats.append(r)
         elif t == "profile":
             profile_events.append(r)
         elif t == "heartbeat_margin":
@@ -378,6 +384,61 @@ def summarize(records: List[dict]) -> dict:
             k: v for k, v in p.items() if k not in ("t", "program")
         }
 
+    # compile provenance (`program` records, telemetry/programs.py,
+    # schema v7): which program built, why, and what it cost — keyed by
+    # fingerprint so a `--compare` can say "run B compiled these programs
+    # run A didn't". Older traces simply have no records here; every
+    # consumer (format_table, compare_format) treats an absent section as
+    # "predates provenance", never as an error.
+    provenance_summary: Dict[str, Any] = {}
+    if prov_records:
+        by_fp: Dict[str, Dict[str, Any]] = {}
+        for r in prov_records:
+            fp = r.get("fingerprint", "?")
+            e = by_fp.setdefault(
+                fp,
+                {"program": r.get("program", "?"), "builds": 0, "warm": 0,
+                 "trace_s": 0.0, "lower_s": 0.0, "compile_s": 0.0,
+                 "compiles": 0, "causes": {}},
+            )
+            if r.get("outcome") == "warm-reuse":
+                e["warm"] += 1
+            else:
+                e["builds"] += 1
+                cause = r.get("cause", "?")
+                e["causes"][cause] = e["causes"].get(cause, 0) + 1
+                for key in ("trace_s", "lower_s", "compile_s"):
+                    e[key] = round(e[key] + r.get(key, 0.0), 6)
+                e["compiles"] += r.get("compiles", 0)
+        for e in by_fp.values():
+            e["build_s"] = round(
+                e["trace_s"] + e["lower_s"] + e["compile_s"], 6
+            )
+        builds = sum(e["builds"] for e in by_fp.values())
+        provenance_summary = {
+            "programs": len(by_fp),
+            "builds": builds,
+            "cold": sum(
+                1 for r in prov_records if r.get("outcome") == "cold"
+            ),
+            "warm_only": sum(
+                1 for e in by_fp.values() if e["builds"] == 0
+            ),
+            "build_s": round(
+                sum(e["build_s"] for e in by_fp.values()), 6
+            ),
+            "by_fingerprint": by_fp,
+        }
+    if cache_stats:
+        # last snapshot stands (cumulative counters, like the service
+        # health records)
+        last = cache_stats[-1]
+        provenance_summary["cache"] = {
+            k: last[k]
+            for k in ("entries", "hits", "misses", "evictions")
+            if k in last
+        }
+
     # heartbeat margin (supervision.heartbeat + BLADES_HEARTBEAT_TIMEOUT):
     # how close beats came to the supervisor's kill threshold
     heartbeat_summary: Dict[str, float] = {}
@@ -446,6 +507,7 @@ def summarize(records: List[dict]) -> dict:
         "service": service_summary,
         "metrics": metrics_summary,
         "programs": program_summary,
+        "provenance": provenance_summary,
         "heartbeat": heartbeat_summary,
         "profile_events": len(profile_events),
         "block": block_summary,
@@ -598,6 +660,32 @@ def format_table(summary: dict) -> str:
             for k, v in sorted(p.items())
         )
         lines.append(f"program[{name}]: {pairs}")
+    prov = summary.get("provenance") or {}
+    if prov.get("by_fingerprint"):
+        lines.append(
+            f"\ncompile provenance: {prov['programs']} programs, "
+            f"{prov['builds']} builds ({prov['cold']} cold), "
+            f"{prov['build_s']:.2f}s trace+lower+compile"
+        )
+        lines.append(
+            f"  {'program':<26}{'fingerprint':<16}{'builds':>7}{'warm':>6}"
+            f"{'build_s':>9}  causes"
+        )
+        by_fp = prov["by_fingerprint"]
+        for fp in sorted(by_fp, key=lambda f: -by_fp[f]["build_s"]):
+            e = by_fp[fp]
+            causes = ",".join(
+                f"{k}x{v}" if v > 1 else k
+                for k, v in sorted(e["causes"].items())
+            )
+            lines.append(
+                f"  {e['program']:<26}{fp:<16}{e['builds']:>7}{e['warm']:>6}"
+                f"{e['build_s']:>9.2f}  {causes}"
+            )
+        cache = prov.get("cache")
+        if cache:
+            pairs = ", ".join(f"{k}={v}" for k, v in sorted(cache.items()))
+            lines.append(f"  engine cache: {pairs}")
     met = summary.get("metrics") or {}
     if met:
         pairs = ", ".join(
@@ -787,6 +875,49 @@ def compare_format(sa: dict, sb: dict, la: str = "A", lb: str = "B") -> str:
             fb = f"{vb * 1e3:>12.1f}" if vb is not None else f"{'—':>12}"
             rr = ratio(va, vb) if va is not None and vb is not None else f"{'—':>8}"
             lines.append(f"{label:<28}{fa}{fb}{rr}")
+    # compile-provenance program-set diff (schema v7 `program` records):
+    # which programs one run built that the other didn't, and the cost.
+    # Traces predating v7 have no provenance section — diff what exists
+    # and say so ONCE instead of failing (cross-schema-version contract).
+    pa = (sa.get("provenance") or {}).get("by_fingerprint")
+    pb = (sb.get("provenance") or {}).get("by_fingerprint")
+    if pa is None and pb is None:
+        pass  # both traces predate program records: nothing to diff
+    elif pa is None or pb is None:
+        missing = "A" if pa is None else "B"
+        lines.append(
+            f"NOTE: trace {missing} has no `program` records (predates "
+            "schema v7 compile provenance) — program-set diff skipped"
+        )
+    else:
+        both = sorted(set(pa) | set(pb))
+        builds_a = sum(e["builds"] for e in pa.values())
+        builds_b = sum(e["builds"] for e in pb.values())
+        lines.append(
+            f"{'program builds':<28}{builds_a:>12}{builds_b:>12}"
+            f"{ratio(builds_a, builds_b)}"
+        )
+        va = sum(e["build_s"] for e in pa.values())
+        vb = sum(e["build_s"] for e in pb.values())
+        lines.append(
+            f"{'program build_s':<28}{va:>12.2f}{vb:>12.2f}{ratio(va, vb)}"
+        )
+        only_a = [fp for fp in both if fp in pa and fp not in pb]
+        only_b = [fp for fp in both if fp in pb and fp not in pa]
+        for label, only, side in (("only in A", only_a, pa),
+                                  ("only in B", only_b, pb)):
+            if not only:
+                continue
+            cost = sum(side[fp]["build_s"] for fp in only)
+            names = ", ".join(
+                f"{side[fp]['program']}[{fp[:12]}]"
+                for fp in sorted(only, key=lambda f: -side[f]["build_s"])[:5]
+            )
+            more = f" (+{len(only) - 5} more)" if len(only) > 5 else ""
+            lines.append(
+                f"  programs {label}: {len(only)} costing {cost:.2f}s — "
+                f"{names}{more}"
+            )
     return "\n".join(lines)
 
 

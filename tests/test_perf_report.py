@@ -182,7 +182,8 @@ def test_resume_overhead_guard(tmp_path):
                                 "i": i, "total": 5}) + "\n")
     stats = perf_report.sweep_resume_stats([ok_trace])
     assert stats == [{"trace": ok_trace, "sweep": "certify",
-                      "skipped": 3, "total": 5, "executed": 2}]
+                      "skipped": 3, "total": 5, "executed": 2,
+                      "program_builds": 0, "programs_built": []}]
     assert perf_report.check_resume_overhead(stats) == []
 
     # resumed re-emits don't count as executed

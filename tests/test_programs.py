@@ -1,0 +1,367 @@
+"""Compile-provenance tests (``blades_tpu/telemetry/programs.py``): the
+per-program build ledger that attributes every trace/lower/compile to a
+fingerprint, a cause, and a cache outcome.
+
+Three layers, mirroring the module's contract:
+
+- **registry semantics** (synthetic events, no jax): outcome and cause
+  classification, warm-once emission, the unattributed bucket, the
+  bounded in-process ledger, reset;
+- **the tiling invariant** (real jax): on a multi-program run every
+  watched dispatch's trace+lower+compile seconds land in exactly one
+  scope, and the attributed share of the process-wide
+  ``recorder.process_counters()`` mirror stays ≥ 95% (the ISSUE 16
+  acceptance bar);
+- **surfaces**: the schema-v7 ``program``/``cache_stats`` records
+  validate, every committed trace under ``results/`` still validates,
+  and the trace_summary / sweep_status rollups read the new records.
+
+The reference has no compile accounting at all
+(``src/blades/simulator.py:453-455`` records whole-round wall only);
+the acceptance bar comes from ISSUE 16.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import pytest
+
+from blades_tpu.telemetry import (
+    Recorder,
+    get_recorder,
+    set_recorder,
+)
+from blades_tpu.telemetry import programs
+from blades_tpu.telemetry import recorder as recorder_mod
+from blades_tpu.telemetry import schema as tschema
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from sweep_status import summarize_programs  # noqa: E402
+from trace_summary import summarize  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    prev = get_recorder()
+    programs.reset()
+    yield
+    set_recorder(prev)
+    programs.reset()
+
+
+def _synthetic_build(trace_s=0.2, compile_s=0.5, compiles=1):
+    """Feed one build's worth of counter events into the open scope the
+    way install_jax_monitoring's listeners would."""
+    if trace_s:
+        programs._observe("xla.trace_s", trace_s)
+    if compile_s:
+        programs._observe("xla.compile_s", compile_s)
+    if compiles:
+        programs._observe("xla.compiles", compiles)
+
+
+# ------------------------------------------------------- registry semantics
+
+
+def test_cold_build_emits_program_record_with_cause():
+    rec = Recorder(enabled=True)
+    set_recorder(rec)
+    with programs.watch("t/round", shapes=(4, 8), donation=(0,)):
+        _synthetic_build()
+    recs = [r for r in rec.records if r["t"] == "program"]
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["program"] == "t/round"
+    assert r["outcome"] == "cold"
+    assert r["cause"] == "new-fingerprint"
+    assert r["compiles"] == 1 and r["compile_s"] == 0.5
+    assert len(r["fingerprint"]) == 12  # derived sha256 prefix
+    # deterministic fallback fingerprint: same identity -> same fp
+    assert r["fingerprint"] == programs.derive_fingerprint(
+        "t/round", programs._key_str((4, 8)), programs._key_str((0,))
+    )
+
+
+def test_warm_reuse_emits_at_most_once_per_program():
+    rec = Recorder(enabled=True)
+    set_recorder(rec)
+    with programs.watch("t/round", fingerprint="fp1"):
+        _synthetic_build()
+    for _ in range(3):  # three warm dispatches, no build events
+        with programs.watch("t/round", fingerprint="fp1"):
+            pass
+    recs = [r for r in rec.records if r["t"] == "program"]
+    assert [r["outcome"] for r in recs] == ["cold", "warm-reuse"]
+    assert "cause" not in recs[1]
+    snap = programs.snapshot()
+    assert snap["programs"]["fp1"]["warm"] == 3
+    assert snap["programs"]["fp1"]["builds"] == 1
+
+
+def test_persistent_cache_hit_outcome():
+    # traced+lowered but zero backend compiles: the single-core cost the
+    # persistent XLA cache does NOT absorb
+    with programs.watch("t/cached", fingerprint="fpc"):
+        programs._observe("xla.trace_s", 0.3)
+        programs._observe("xla.cache_hits", 1)
+    ev = programs.events()[-1]
+    assert ev["outcome"] == "persistent-cache-hit"
+    assert ev["cause"] == "new-fingerprint"
+    assert ev["cache_hits"] == 1
+
+
+def test_shape_and_donation_change_causes():
+    with programs.watch("t/f", shapes=(4,), donation=(0,)):
+        _synthetic_build()
+    with programs.watch("t/f", shapes=(8,), donation=(0,)):
+        _synthetic_build()
+    with programs.watch("t/f", shapes=(8,), donation=()):
+        _synthetic_build()
+    causes = [e["cause"] for e in programs.events()]
+    assert causes == ["new-fingerprint", "shape-change", "donation-change"]
+
+
+def test_eviction_cause_via_note_eviction():
+    with programs.watch("t/g", fingerprint="fpg", shapes=(4,)):
+        _synthetic_build()
+    programs.note_eviction("fpg")
+    with programs.watch("t/g", fingerprint="fpg", shapes=(4,)):
+        _synthetic_build()
+    assert programs.events()[-1]["cause"] == "cache-eviction"
+    # rebuilding the SAME (fingerprint, shapes) again is an eviction too,
+    # even without an explicit note (the executable must have been lost)
+    with programs.watch("t/g", fingerprint="fpg", shapes=(4,)):
+        _synthetic_build()
+    assert programs.events()[-1]["cause"] == "cache-eviction"
+
+
+def test_cause_hint_wins_for_first_build():
+    with programs.watch("t/eval", cause_hint="first-eval"):
+        _synthetic_build()
+    assert programs.events()[-1]["cause"] == "first-eval"
+
+
+def test_unattributed_bucket_and_coverage():
+    with programs.watch("t/h"):
+        programs._observe("xla.trace_s", 0.9)
+        programs._observe("xla.compiles", 1)
+    # a build with NO open scope folds into the unattributed bucket
+    programs._observe("xla.trace_s", 0.1)
+    snap = programs.snapshot()
+    assert snap["attributed"]["trace_s"] == pytest.approx(0.9)
+    assert snap["unattributed"]["trace_s"] == pytest.approx(0.1)
+    assert snap["coverage"] == pytest.approx(0.9)
+
+
+def test_nested_scopes_attribute_to_innermost():
+    with programs.watch("t/outer", fingerprint="fpo"):
+        with programs.watch("t/inner", fingerprint="fpi"):
+            _synthetic_build()
+    snap = programs.snapshot()
+    assert snap["programs"]["fpi"]["builds"] == 1
+    assert snap["programs"]["fpo"]["builds"] == 0  # warm-reuse only
+
+
+def test_events_ledger_is_bounded(monkeypatch):
+    monkeypatch.setattr(programs, "_MAX_EVENTS", 8)
+    for i in range(20):
+        with programs.watch(f"t/p{i}"):
+            _synthetic_build()
+    assert len(programs.events()) <= 8
+    assert programs.snapshot()["dropped"] > 0
+    # the survivors are the NEWEST records
+    assert programs.events()[-1]["program"] == "t/p19"
+
+
+def test_disabled_recorder_emits_nothing_but_ledger_keeps_accounting():
+    set_recorder(None)  # NULL recorder: disabled
+    with programs.watch("t/quiet", fingerprint="fpq"):
+        _synthetic_build()
+    assert get_recorder().records == []
+    assert programs.events()[-1]["fingerprint"] == "fpq"
+    assert programs.snapshot()["programs"]["fpq"]["builds"] == 1
+
+
+def test_reset_clears_everything():
+    with programs.watch("t/r"):
+        _synthetic_build()
+    programs._observe("xla.trace_s", 0.1)
+    programs.reset()
+    snap = programs.snapshot()
+    assert snap["programs"] == {} and snap["emitted"] == 0
+    assert snap["attributed"] == {} and snap["unattributed"] == {}
+    assert snap["coverage"] == 1.0
+
+
+def test_program_and_cache_stats_records_validate_against_schema():
+    rec = Recorder(enabled=True)
+    set_recorder(rec)
+    with programs.watch("t/s", shapes=(4,), donation=(0,)):
+        _synthetic_build()
+    from blades_tpu.sweeps import EngineCache
+
+    cache = EngineCache()
+    cache.put("k1", object(), build_s=0.5)
+    cache.get("k1")
+    rec.event("cache_stats", ts=1.0, **cache.stats())
+    sch = tschema.load_schema()
+    for r in rec.records:
+        errs = tschema.validate_record(r, sch)
+        assert not errs, (r, errs)
+
+
+# ------------------------------------------------------------- EngineCache
+
+
+def test_engine_cache_per_key_stats_and_lru_eviction():
+    from blades_tpu.sweeps import EngineCache
+
+    cache = EngineCache(max_entries=2)
+    cache.put("a", 1, build_s=0.5)
+    cache.put("b", 2, build_s=0.7)
+    assert cache.get("a") == 1
+    assert cache.get("missing") is None
+    cache.put("c", 3)  # evicts b (a was just used)
+    st = cache.stats()
+    assert st["entries"] == 2 and st["evictions"] == 1
+    assert st["hits"] == 1 and st["misses"] == 1
+    # stats persist across eviction (b keeps its history for the
+    # affinity signal even after its entry is dropped)
+    assert set(st["by_key"]) >= {"a", "b", "c"}
+    assert st["by_key"]["a"]["hits"] == 1
+    assert st["by_key"]["a"]["build_s"] == 0.5
+    assert cache.get("b") is None  # evicted
+    # the eviction was reported to the provenance registry: the next
+    # build of that fingerprint is attributed cache-eviction
+    with programs.watch("t/engine", fingerprint="b"):
+        _synthetic_build()
+    assert programs.events()[-1]["cause"] == "cache-eviction"
+
+
+# ------------------------------------------------- tiling invariant (jax)
+
+
+def test_tiling_invariant_on_multi_program_run(tmp_path):
+    """ISSUE 16 acceptance: on a fresh multi-program run (engine round +
+    eval + dataset sampler programs), the per-program trace+lower+compile
+    seconds sum to >= 95% of the process-wide ``xla.*`` mirror over the
+    same window — every watched dispatch's build cost lands in exactly
+    one scope."""
+    from blades_tpu import Simulator
+    from blades_tpu.datasets import Synthetic
+    from blades_tpu.sweeps import EngineCache
+
+    before = recorder_mod.process_counters()
+    programs.reset()
+    ds = Synthetic(num_clients=4, train_size=64, test_size=32, noise=0.3,
+                   cache=False)
+    cache = EngineCache()
+    sim = Simulator(ds, log_path=str(tmp_path / "out"), seed=0,
+                    aggregator="mean")
+    sim.run("mlp", global_rounds=2, local_steps=1, client_lr=0.2,
+            validate_interval=1, train_batch_size=8, engine_cache=cache)
+    after = recorder_mod.process_counters()
+    mirror = sum(
+        after.get(f"xla.{k}", 0.0) - before.get(f"xla.{k}", 0.0)
+        for k in ("trace_s", "lower_s", "compile_s")
+    )
+    snap = programs.snapshot()
+    attributed = sum(
+        snap["attributed"].get(k, 0.0) for k in programs.SECONDS_FIELDS
+    )
+    assert mirror > 0, "run compiled nothing — the fixture is broken"
+    assert attributed >= 0.95 * mirror, (
+        f"attributed {attributed:.3f}s < 95% of mirror {mirror:.3f}s "
+        f"(snapshot: {snap['attributed']} vs {snap['unattributed']})"
+    )
+    assert snap["coverage"] >= 0.95
+    # the expected program population: round + eval + sampler, each with
+    # a build outcome and a classified cause
+    labels = {v["program"] for v in snap["programs"].values()
+              if v["builds"]}
+    assert "engine/round" in labels
+    assert "dataset/sample_round" in labels
+    assert any(lbl.startswith("engine/eval") for lbl in labels)
+    first_build = {}
+    for e in programs.events():
+        if e["outcome"] != "warm-reuse":
+            first_build.setdefault(e["program"], e)
+    assert first_build["engine/round"]["cause"] == "new-fingerprint"
+    assert any(e.get("cause") == "first-eval"
+               for e in programs.events() if "eval" in e["program"])
+    # any LATER rebuild of an already-built identity must carry an
+    # attributed cause, never a bare new-fingerprint (the whole point:
+    # an unexplained recompile is nameable, e.g. the 8-device CPU mesh's
+    # donated-state second-round rebuild surfaces as cache-eviction)
+    for e in programs.events():
+        if (e["outcome"] != "warm-reuse"
+                and e is not first_build[e["program"]]):
+            assert e.get("cause") in programs.CAUSES
+    # the trace carries the same records, schema-valid
+    trace = os.path.join(str(tmp_path / "out"), "telemetry.jsonl")
+    errs = tschema.validate_trace(trace)
+    assert not errs, errs[:3]
+    trace_recs = [json.loads(l) for l in open(trace) if l.strip()]
+    prog_recs = [r for r in trace_recs if r.get("t") == "program"]
+    assert {r["program"] for r in prog_recs} >= {"engine/round",
+                                                 "dataset/sample_round"}
+    # second run from the warm engine cache: the round program is
+    # warm-reused (zero build-outcome records for it), and the cache's
+    # hit stats agree with the emitted engine_cache hit records
+    n_before = len(programs.events())
+    sim2 = Simulator(ds, log_path=str(tmp_path / "out2"), seed=0,
+                     aggregator="mean")
+    sim2.run("mlp", global_rounds=1, local_steps=1, client_lr=0.2,
+             validate_interval=1, train_batch_size=8, engine_cache=cache)
+    window = programs.events()[n_before:]
+    assert not any(
+        e["outcome"] != "warm-reuse" and e["program"] == "engine/round"
+        for e in window
+    ), f"warm engine round rebuilt: {window}"
+    st = cache.stats()
+    trace2 = os.path.join(str(tmp_path / "out2"), "telemetry.jsonl")
+    hit_recs = [
+        r for p in (trace, trace2) for r in
+        (json.loads(l) for l in open(p) if l.strip())
+        if r.get("t") == "engine_cache"
+    ]
+    assert st["hits"] == len(hit_recs) == 1
+    assert st["misses"] == 1 and st["entries"] == 1
+    (key_stats,) = st["by_key"].values()
+    assert key_stats["hits"] == 1 and key_stats["build_s"] > 0
+
+    # surface rollups read the records
+    roll = summarize_programs(trace_recs)
+    assert roll is not None and roll["programs"] >= 2
+    assert roll["top"][0]["build_s"] >= roll["top"][-1]["build_s"]
+    summary = summarize(trace_recs)
+    prov = summary["provenance"]
+    assert prov["builds"] >= 2 and prov["cold"] >= 1
+
+
+# ------------------------------------------------------- committed traces
+
+
+def test_every_committed_trace_validates_against_schema():
+    """Satellite 1: sweep every committed trace under results/ through
+    the schema checker — a schema bump that strands an older committed
+    artifact fails here, not in the next debugging session."""
+    paths = sorted(
+        glob.glob(os.path.join(REPO, "results", "**", "*.jsonl"),
+                  recursive=True)
+    )
+    traced = [
+        p for p in paths
+        if os.path.basename(p) in (
+            "telemetry.jsonl", "sweep_trace.jsonl", "service_trace.jsonl"
+        )
+    ]
+    assert traced, "no committed traces found under results/"
+    sch = tschema.load_schema()
+    for p in traced:
+        errs = tschema.validate_trace(p, sch)
+        assert not errs, (p, errs[:3])

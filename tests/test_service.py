@@ -20,6 +20,7 @@ Reference counterpart: none — the reference runs one configuration per
 cold process and has no serving surface (`src/blades/simulator.py`).
 """
 
+import glob
 import importlib.util
 import json
 import os
@@ -557,6 +558,7 @@ def test_warm_serving_zero_compiles(tmp_path):
     request-path accounting classifies the pair cold-then-warm with a
     split that tiles each request's wall."""
     from blades_tpu.service.server import SimulationService
+    from blades_tpu.telemetry import programs as _programs
     from blades_tpu.telemetry import recorder as _trec
 
     svc = SimulationService(str(tmp_path / "svc"))
@@ -567,10 +569,20 @@ def test_warm_serving_zero_compiles(tmp_path):
     first = svc._execute("r1", req)
     assert first["ok"], first
     before = _trec.process_counters()
+    prov_before = len(_programs.events())
     second = svc._execute("r2", req)
     delta = _trec.process_counters().get("xla.compiles", 0) - before.get(
         "xla.compiles", 0)
     assert delta == 0
+    # compile provenance (telemetry/programs.py): the warm repeat emits
+    # ZERO cold-outcome program records — the in-process form of the
+    # perf_report warm_program_builds pin (a tiny eager re-trace may
+    # close as persistent-cache-hit; only a real compile is a violation)
+    warm_builds = [
+        e for e in _programs.events()[prov_before:]
+        if e.get("outcome") == "cold"
+    ]
+    assert not warm_builds, warm_builds
     assert second["cells"] == first["cells"]
     assert svc._engine_cache.stats()["hits"] >= 1
     # warm/cold classification pinned on the zero-new-compiles fixture:
@@ -597,6 +609,26 @@ def test_warm_serving_zero_compiles(tmp_path):
         assert abs(
             r["queue_wait_s"] + r["build_s"] + r["execute_s"] - r["total_s"]
         ) < 1e-4
+    # a health beat flushes the per-fingerprint cache stats; the hit
+    # counter must match the engine_cache hit records the warm cells
+    # emitted into their per-request Simulator traces exactly
+    svc._health()
+    recs = [json.loads(l) for l in
+            open(os.path.join(str(tmp_path / "svc"), "service_trace.jsonl"))
+            if l.strip()]
+    cache_stats = [r for r in recs if r.get("t") == "cache_stats"]
+    assert cache_stats, "health beat emitted no cache_stats record"
+    hit_records = [
+        json.loads(l)
+        for p in glob.glob(os.path.join(
+            str(tmp_path / "svc"), "requests", "*", "*", "telemetry.jsonl"))
+        for l in open(p) if l.strip()
+        and json.loads(l).get("t") == "engine_cache"
+    ]
+    assert cache_stats[-1]["hits"] == len(hit_records) == 1
+    assert cache_stats[-1]["entries"] == 1
+    (per_key,) = cache_stats[-1]["by_key"].values()
+    assert per_key["hits"] == 1 and per_key["build_s"] is not None
 
 
 # -- perf-gate guard (fire + pass directions) ----------------------------------

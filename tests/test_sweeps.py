@@ -295,7 +295,13 @@ def test_engine_cache_hits_and_stats():
     assert cache.get("k1") is None
     cache.put("k1", "engine")
     assert cache.get("k1") == "engine"
-    assert cache.stats() == {"entries": 1, "hits": 1, "misses": 1}
+    st = cache.stats()
+    assert (st["entries"], st["hits"], st["misses"], st["evictions"]) == (
+        1, 1, 1, 0)
+    # per-fingerprint stats (compile provenance): the miss seeded the
+    # per-key entry, the hit incremented it
+    assert st["by_key"]["k1"]["hits"] == 1
+    assert st["by_key"]["k1"]["misses"] == 1
 
 
 def test_program_fingerprint_stable_across_equal_configs():
