@@ -62,6 +62,10 @@ NO_JAX_SUFFIXES = (
     "blades_tpu/service/client.py",
     "blades_tpu/service/spool.py",
     "blades_tpu/service/server.py",
+    # the multi-tenant scheduler (PR 17) sits on the listener's admission
+    # path (overflow verdicts, deadline estimates) — it must work with
+    # the tunnel down, jax-free, like the rest of the service layer
+    "blades_tpu/service/scheduler.py",
 )
 
 #: blades modules known to import jax at module scope — importing one of

@@ -127,12 +127,25 @@ class ServiceClient:
         request_id: Optional[str] = None,
         wait: bool = True,
         timeout: Optional[float] = None,
+        client: Optional[str] = None,
+        priority: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> Dict[str, Any]:
+        """Submit one request. ``client`` (tenant label), ``priority``
+        (``interactive``/``normal``/``batch``) and ``deadline_s``
+        (deadline-aware admission) are conveniences that set the
+        corresponding request-body fields when given."""
         msg: Dict[str, Any] = {
             "op": "submit", "request": dict(request), "wait": bool(wait),
         }
         if request_id is not None:
             msg["request"]["id"] = request_id
+        if client is not None:
+            msg["request"]["client"] = client
+        if priority is not None:
+            msg["request"]["priority"] = priority
+        if deadline_s is not None:
+            msg["request"]["deadline_s"] = float(deadline_s)
         return self.request(msg, timeout=timeout)
 
     def result(self, request_id: str) -> Dict[str, Any]:

@@ -22,6 +22,22 @@ executor consumes (:func:`blades_tpu.sweeps.resilient
   of serving from one long-lived process. Results are deterministic
   functions of the scenario (loss + a params content hash), so a
   journaled resume is content-identical by construction.
+- ``sweep`` — a whole sweep DRIVER as one request body: ``{"kind":
+  "sweep", "sweep": "certify" | "chaos", "spec": {...driver knobs...}}``
+  loads ``scripts/certify.py`` / ``scripts/chaos.py`` (stdlib at module
+  scope — importable on the pre-jax listener path) and runs the same
+  enumerate → resilient-execute → assemble pipeline the CLI runs, under
+  the SERVER's journal/accounting/scheduler: the sweep drivers become
+  real tenants (priority ``batch`` by convention), preemptible at cell
+  boundaries by higher-priority work and resumed content-identically
+  from the per-request ``SweepJournal``.
+
+Every kind reduces to a :class:`RequestPlan` (:func:`build_plan`): the
+cell labels (journal/spool identity), an ``execute`` closure the server
+drives with its own resilient options (including the scheduler's
+``should_yield`` hook), and an optional ``finalize`` that assembles the
+driver's evidence artifact once every cell has actually executed — a
+preempted run must NOT finalize from a half-executed result list.
 
 Cell payloads must stay JSON-round-trippable: the spool and the cell
 journal both persist them, and a resumed request re-executes from the
@@ -40,9 +56,21 @@ import re
 import time
 from typing import Any, Callable, Dict, List, Tuple
 
-__all__ = ["build_cells", "make_runner", "safe_name", "REQUEST_KINDS"]
+__all__ = [
+    "REQUEST_KINDS",
+    "SWEEP_DRIVERS",
+    "RequestPlan",
+    "build_cells",
+    "build_plan",
+    "estimate_cells",
+    "make_runner",
+    "safe_name",
+]
 
-REQUEST_KINDS = ("probe", "simulate")
+REQUEST_KINDS = ("probe", "simulate", "sweep")
+
+#: Sweep drivers routable as a ``sweep`` request body.
+SWEEP_DRIVERS = ("certify", "chaos")
 
 #: Request ids and cell labels become FILESYSTEM path segments (the
 #: per-request journal dir, each simulate cell's log dir) — and the
@@ -93,6 +121,11 @@ def build_cells(request: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
         raise ValueError(
             f"unknown request kind {kind!r} (supported: {REQUEST_KINDS})"
         )
+    if kind == "sweep":
+        raise ValueError(
+            "sweep requests carry a driver spec, not a cells list "
+            "(use build_plan)"
+        )
     raw = request.get("cells")
     if not isinstance(raw, list) or not raw:
         raise ValueError("request has no cells (expected a non-empty list)")
@@ -122,6 +155,194 @@ def make_runner(
     if request.get("kind") == "probe":
         return _run_probe
     return lambda payload: _run_simulate(payload, ctx)
+
+
+# -- sweep drivers as request bodies -------------------------------------------
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+_drivers: Dict[str, Any] = {}
+
+
+def _load_driver(name: str):
+    """Load (once) a sweep driver script as a module. Both drivers are
+    stdlib-only at module scope (they lazy-import jax inside the sweep
+    functions), so loading one on the listener path — the admission
+    estimator needs ``spec_namespace``/``total_cells`` — keeps the
+    pre-jax import contract (IMP001 probes it)."""
+    mod = _drivers.get(name)
+    if mod is None:
+        import importlib.util
+
+        path = os.path.join(_REPO, "scripts", f"{name}.py")
+        spec = importlib.util.spec_from_file_location(
+            f"_blades_sweep_driver_{name}", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _drivers[name] = mod
+    return mod
+
+
+def estimate_cells(request: Dict[str, Any]) -> int:
+    """Jax-free cell count for one request — the admission estimator's
+    input (``blades_tpu/service/scheduler.py:CostEstimator``) and the
+    admitted ``request`` record's ``cells`` field. Malformed requests
+    count 0 (they reject at execution with an attributable error; the
+    estimator must never fail admission)."""
+    try:
+        kind = request.get("kind")
+        if kind == "sweep":
+            driver = request.get("sweep")
+            spec = request.get("spec") or {}
+            if driver == "chaos":
+                return max(0, int(spec.get("scenarios") or 0))
+            if driver == "certify":
+                mod = _load_driver("certify")
+                return int(mod.total_cells(mod.spec_namespace(spec)))
+            return 0
+        return len(build_cells(request))
+    except Exception:  # noqa: BLE001 - advisory count, never an admission error
+        return 0
+
+
+class RequestPlan:
+    """One request's execution recipe, kind-agnostic for the server.
+
+    - ``labels``: cell labels in reply order (journal/spool identity);
+    - ``execute(sweep=, journal=, options=)``: runs the cells under the
+      resilient executor, returns its raw ``(results, walls, report)``;
+    - ``finalize(results, walls, report)``: optional — extra reply
+      fields assembled AFTER a complete (non-preempted) execution;
+    - ``slim_cells``: omit raw per-cell result bodies from the reply
+      (sweep drivers return their assembled artifact via ``finalize``;
+      duplicating thousands of raw search cells would bloat the spool);
+    - ``resilience_kw``: per-request overrides for the server's
+      ``ResilienceOptions`` (a spec's explicit attempts/deadline knobs).
+    """
+
+    def __init__(self, labels, execute, finalize=None, slim_cells=False,
+                 resilience_kw=None):
+        self.labels = list(labels)
+        self.execute = execute
+        self.finalize = finalize
+        self.slim_cells = bool(slim_cells)
+        self.resilience_kw = dict(resilience_kw or {})
+
+
+def build_plan(request: Dict[str, Any], ctx: Dict[str, Any]) -> RequestPlan:
+    """Validate a request and return its :class:`RequestPlan`; raises
+    ``ValueError`` on a malformed request (the server's attributable
+    error reply). ``ctx`` carries the server's shared state (``cache``,
+    ``out_dir``, ``request_id``, ``datasets``)."""
+    if request.get("kind") == "sweep":
+        driver = request.get("sweep")
+        if driver not in SWEEP_DRIVERS:
+            raise ValueError(
+                f"unknown sweep driver {driver!r} "
+                f"(supported: {SWEEP_DRIVERS})"
+            )
+        spec = request.get("spec") or {}
+        if not isinstance(spec, dict):
+            raise ValueError("sweep spec must be an object")
+        if driver == "certify":
+            return _certify_plan(spec, ctx)
+        return _chaos_plan(spec, ctx)
+
+    cells = build_cells(request)
+    run_cell = make_runner(request, ctx)
+
+    def execute(sweep=None, journal=None, options=None):
+        from blades_tpu.sweeps.resilient import run_cells_resilient
+
+        return run_cells_resilient(
+            list(cells), run_cell, sweep=sweep, journal=journal,
+            options=options, kind="service",
+        )
+
+    return RequestPlan([label for label, _ in cells], execute)
+
+
+def _certify_plan(spec: Dict[str, Any], ctx: Dict[str, Any]) -> RequestPlan:
+    """The certification matrix as a request: enumerate the SweepCells
+    now (labels are the journal identity), execute under the server's
+    options, assemble the matrix only from a complete run."""
+    mod = _load_driver("certify")
+    args = mod.spec_namespace(spec)  # ValueError on unknown/bad knobs
+    _force_platform_once()
+    plans, specs = mod.enumerate_cells(args)
+
+    def execute(sweep=None, journal=None, options=None):
+        return mod.execute_cells(
+            args, plans, specs, sweep=sweep, journal=journal,
+            resilience=options,
+        )
+
+    def finalize(results, walls, report):
+        matrix = mod.assemble_matrix(
+            args, plans, specs, results, walls, report
+        )
+        return {"sweep": {"driver": "certify", "matrix": matrix}}
+
+    kw: Dict[str, Any] = {}
+    if "attempts" in spec:
+        kw["attempts"] = args.attempts
+    if "cell_deadline" in spec:
+        kw["cell_deadline_s"] = args.cell_deadline
+    return RequestPlan(
+        [s.label for s in specs], execute, finalize=finalize,
+        slim_cells=True, resilience_kw=kw,
+    )
+
+
+def _chaos_plan(spec: Dict[str, Any], ctx: Dict[str, Any]) -> RequestPlan:
+    """Chaos scenarios 0..N-1 as a request: one cell per seed (scenario
+    + twin/block reruns), engines served from the server's warm
+    EngineCache, the sweep summary assembled by the driver's own
+    ``summarize_rows``."""
+    mod = _load_driver("chaos")
+    unknown = sorted(set(spec) - {"scenarios", "attempts"})
+    if unknown:
+        raise ValueError(f"unknown chaos spec keys: {unknown}")
+    n = int(spec.get("scenarios") or 0)
+    if not 1 <= n <= 1000:
+        raise ValueError("chaos spec needs 1 <= scenarios <= 1000")
+    _force_platform_once()
+    labels = [
+        f"s{seed:03d}/{mod.make_scenario(seed)['agg']}" for seed in range(n)
+    ]
+    out_dir = os.path.join(
+        ctx["out_dir"], "requests", str(ctx["request_id"]), "chaos"
+    )
+    cache = ctx.get("cache")
+
+    def execute(sweep=None, journal=None, options=None):
+        from blades_tpu.sweeps.resilient import run_cells_resilient
+
+        return run_cells_resilient(
+            [(labels[seed], seed) for seed in range(n)],
+            lambda seed: mod._sweep_cell(
+                mod.make_scenario(seed), seed, out_dir, cache
+            ),
+            sweep=sweep, journal=journal, options=options, kind="chaos",
+        )
+
+    def finalize(results, walls, report):
+        stats = cache.stats() if cache is not None else {}
+        return {"sweep": {
+            "driver": "chaos",
+            "summary": mod.summarize_rows(n, results, report, stats),
+        }}
+
+    kw: Dict[str, Any] = {}
+    if "attempts" in spec:
+        kw["attempts"] = int(spec["attempts"])
+    return RequestPlan(
+        labels, execute, finalize=finalize, slim_cells=True,
+        resilience_kw=kw,
+    )
 
 
 # -- probe ---------------------------------------------------------------------

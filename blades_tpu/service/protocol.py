@@ -29,14 +29,25 @@ Client -> server messages carry an ``op``:
   reply to waiting clients, exit 0 (the in-band form of SIGTERM).
 - ``{"op": "ping"}`` — liveness.
 
-A request body is ``{"id": optional, "client": optional, "kind":
-"probe" | "simulate", "cells": [...]}`` — per-cell payloads are
-handler-specific (:mod:`blades_tpu.service.handlers`). Client-supplied
-ids make resubmission idempotent: a ``submit`` whose id the spool
-already holds a reply for is served from the spool, never re-executed.
-``client`` is an optional tenant label (same safe charset as ids) keyed
-into the per-client metrics tables — the hook per-tenant scheduling
-will build on.
+A request body is ``{"id": optional, "client": optional, "priority":
+optional, "deadline_s": optional, "kind": "probe" | "simulate", "cells":
+[...]}`` or — for the sweep-driver tenants — ``{"kind": "sweep",
+"sweep": "certify" | "chaos", "spec": {...}}`` (the spec is the driver's
+own CLI surface as a dict; :mod:`blades_tpu.service.handlers` validates
+it). Per-cell payloads are handler-specific
+(:mod:`blades_tpu.service.handlers`). Client-supplied ids make
+resubmission idempotent: a ``submit`` whose id the spool already holds a
+reply for is served from the spool, never re-executed. ``client`` is the
+tenant label (same safe charset as ids, default ``anon``): it keys the
+per-client metrics tables AND the per-tenant fair-share queue + quota
+(``blades_tpu/service/scheduler.py``). ``priority`` is one of
+``interactive`` / ``normal`` (default) / ``batch`` — strict classes; a
+long-running lower-priority request yields at cell boundaries when
+higher-priority work waits and is resumed from its journal.
+``deadline_s`` opts into deadline-aware admission: a deadline the
+cost estimator (warm/cold latency histograms + per-fingerprint engine
+build stats) judges infeasible is rejected at submit
+(``rejected: deadline_infeasible``) BEFORE the request is spooled.
 
 Stdlib-only, importable before jax (IMP001). Reference counterpart: none
 — the reference has no serving surface (``src/blades/simulator.py``).

@@ -73,15 +73,18 @@ services with explicit pace steering).
 from __future__ import annotations
 
 import os
-import queue
 import signal
 import socket
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 from blades_tpu.service import protocol as _protocol
-from blades_tpu.service.handlers import safe_name  # stdlib at module scope
+from blades_tpu.service import scheduler as _scheduler
+from blades_tpu.service.handlers import (  # stdlib at module scope
+    estimate_cells,
+    safe_name,
+)
 from blades_tpu.service.spool import RequestSpool
 from blades_tpu.supervision import heartbeat as _heartbeat
 from blades_tpu.telemetry import Recorder
@@ -170,6 +173,26 @@ class _RequestAccounting:
         self._svc.metrics.cell(self.request_id)
         self._svc._beat()
 
+    def resume(self, skipped: int, journal: Optional[str] = None,
+               quarantined: int = 0) -> None:
+        """A journaled resume within THIS request (a preempted slice or
+        a crash relaunch): same ``resume`` record the sweep drivers emit
+        (``telemetry/timeline.py``), keyed ``sweep: "service"`` — a
+        driver routed through the service (the ``sweep`` request kind)
+        reports its recovery on the service trace too."""
+        fields: Dict[str, Any] = {
+            "sweep": self.kind,
+            "skipped": int(skipped),
+            "total": self.total,
+            "ts": time.time(),
+        }
+        if quarantined:
+            fields["quarantined"] = int(quarantined)
+        if journal:
+            fields["journal"] = str(journal)
+        self.rec.event("resume", **fields)
+        self.rec.flush()
+
 
 class SimulationService:
     """One warm server process (see the module docstring).
@@ -180,7 +203,12 @@ class SimulationService:
         per-request journals and log dirs all live under it.
     socket_path : override the unix-socket path (``<out>/service.sock``).
     max_queue : admission bound on QUEUED requests (in-flight excluded);
-        breaching it returns ``rejected: backpressure``.
+        breaching it returns ``rejected: backpressure`` blaming the
+        deepest-queued tenant (``blades_tpu/service/scheduler.py``).
+    tenant_quota : per-tenant queue bound; ``None`` (default) keeps the
+        global bound only — the pre-scheduler admission semantics. With
+        a quota, a flooding tenant fills its own allotment and absorbs
+        its own rejections while other tenants' quotas stay open.
     attempts / base_delay_s / cell_deadline_s : the resilient ladder's
         knobs, passed through to :class:`~blades_tpu.sweeps.resilient
         .ResilienceOptions` — the per-request deadline is
@@ -197,6 +225,7 @@ class SimulationService:
         out_dir: str,
         socket_path: Optional[str] = None,
         max_queue: int = 8,
+        tenant_quota: Optional[int] = None,
         attempts: int = 2,
         base_delay_s: float = 0.5,
         cell_deadline_s: Optional[float] = None,
@@ -208,6 +237,7 @@ class SimulationService:
         os.makedirs(out_dir, exist_ok=True)
         self.socket_path = _protocol.socket_path_for(out_dir, socket_path)
         self.max_queue = int(max_queue)
+        self.tenant_quota = tenant_quota
         self.attempts = int(attempts)
         self.base_delay_s = float(base_delay_s)
         self.cell_deadline_s = cell_deadline_s
@@ -244,8 +274,11 @@ class SimulationService:
         self._engine_cache = None
         self._datasets: Dict[Any, Any] = {}
 
-        self._queue: "queue.Queue[Tuple[str, Dict[str, Any], Any]]" = (
-            queue.Queue()
+        #: the multi-tenant scheduler replacing PR 14's FIFO queue
+        #: (blades_tpu/service/scheduler.py): priority classes, weighted
+        #: per-tenant fairness, per-tenant quotas, warm-first placement
+        self._sched = _scheduler.TenantScheduler(
+            max_queue=self.max_queue, tenant_quota=self.tenant_quota,
         )
         self._draining = threading.Event()
         self._drain_reason: Optional[str] = None
@@ -257,11 +290,17 @@ class SimulationService:
         #: `op: metrics` reply body and the periodic `metrics_snapshot`
         #: trace record both read from it
         self.metrics = _reqpath.MetricsRegistry()
+        #: deadline-aware admission (scheduler.py CostEstimator): cost
+        #: from the live PR 15 split + PR 16 per-fingerprint build stats
+        self._estimator = _scheduler.CostEstimator(
+            self.metrics.snapshot, self._cache_stats,
+        )
         self.served = 0
         self.rejected = 0
         self.quarantined_requests = 0
         self.failed = 0
         self.resumed_requests = 0
+        self.preemptions = 0
         self.cells_done = 0
         self._t0 = time.monotonic()
         self._last_health = 0.0
@@ -285,6 +324,12 @@ class SimulationService:
         self.cells_done += 1
         _heartbeat.beat(round_idx=self.cells_done)
 
+    def _cache_stats(self) -> Optional[Dict[str, Any]]:
+        """The engine cache's stats, or None before the first build (the
+        estimator's injectable history source)."""
+        cache = self._engine_cache
+        return cache.stats() if cache is not None else None
+
     def _snapshot(self) -> Dict[str, Any]:
         with self._state_lock:
             pending = dict(self._pending_ts)
@@ -293,7 +338,14 @@ class SimulationService:
         now = time.time()
         oldest = min(pending.values(), default=None)
         return {
-            "queue_depth": self._queue.qsize(),
+            "queue_depth": self._sched.qsize(),
+            # per-class depths + per-tenant composition: a starved (or
+            # flooding) tenant is attributable from the status surface,
+            # and a drained batch queue cannot mask a backed-up
+            # interactive one
+            "queue_by_class": self._sched.depth_by_class(),
+            "tenants": self._sched.composition(),
+            "preemptions": self.preemptions,
             "in_flight": 1 if in_flight else 0,
             # the in-flight request's identity and age, not a bare 0/1:
             # a wedged request must be attributable from this surface
@@ -323,6 +375,9 @@ class SimulationService:
             "service",
             event=event,
             queue_depth=snap["queue_depth"],
+            queue_by_class=snap["queue_by_class"],
+            preemptions=snap["preemptions"],
+            **({"tenants": snap["tenants"]} if snap["tenants"] else {}),
             in_flight=snap["in_flight"],
             served=snap["served"],
             rejected=snap["rejected"],
@@ -493,35 +548,93 @@ class SimulationService:
                 f, conn, {"ok": True, "status": "pending", "id": rid}
             )
             return
+        priority = request.get("priority") or "normal"
+        try:
+            _scheduler.priority_rank(priority)
+        except ValueError as e:
+            self._reply_and_close(f, conn, {"ok": False, "error": str(e)})
+            return
         if self._draining.is_set():
             self.rejected += 1
             self.metrics.reject("draining", op=kind, client=client)
             self.event("service", event="reject", reason="draining",
-                        queue_depth=self._queue.qsize())
+                        queue_depth=self._sched.qsize())
             self._reply_and_close(
                 f, conn,
                 {"ok": False, "rejected": "draining",
                  "error": "service is draining; not admitting requests"},
             )
             return
-        if self._queue.qsize() >= self.max_queue:
+        verdict = self._sched.overflow(client)
+        if verdict is not None:
             # admission control: bounded queue, explicit reply — the
-            # 1-core box must shed load, not absorb it into memory
+            # 1-core box must shed load, not absorb it into memory. The
+            # verdict NAMES the tenant whose backlog overflowed (its own
+            # quota, or the deepest tenant when the global cap trips) so
+            # a flooder is attributable and a victim is exonerated from
+            # the reject record itself
             self.rejected += 1
             self.metrics.reject("backpressure", op=kind, client=client)
             self.event("service", event="reject", reason="backpressure",
-                        queue_depth=self._queue.qsize())
+                        queue_depth=self._sched.qsize(),
+                        tenant=verdict["tenant"])
             self._reply_and_close(
                 f, conn,
                 {"ok": False, "rejected": "backpressure",
-                 "queue_depth": self._queue.qsize(),
-                 "max_queue": self.max_queue},
+                 **{k: v for k, v in verdict.items() if k != "reason"}},
+            )
+            return
+        # warm-first affinity: the same request-body fingerprint that
+        # guards the per-request journal keys the EngineCache — a repeat
+        # body lands where its engines are already built (stdlib-safe:
+        # blades_tpu.sweeps is jax-free at module scope)
+        from blades_tpu.sweeps import program_fingerprint
+
+        affinity = program_fingerprint(request={
+            k: v for k, v in request.items() if k != "id"
+        })
+        # deadline-aware admission, BEFORE spooling: an infeasible
+        # deadline is rejected while rejecting is still cheap — never
+        # durably admitted, never executed, never replayed on resume
+        deadline_s = request.get("deadline_s")
+        if deadline_s is not None:
+            try:
+                deadline_s = float(deadline_s)
+                if deadline_s <= 0:
+                    raise ValueError
+            except (TypeError, ValueError):
+                self._reply_and_close(
+                    f, conn,
+                    {"ok": False,
+                     "error": "deadline_s must be a positive number"},
+                )
+                return
+        n_cells = estimate_cells(request)
+        verdict_name, est = self._estimator.verdict(
+            n_cells, deadline_s,
+            backlog_s=self._sched.backlog_s(priority),
+            warm=self._sched.is_warm(affinity),
+        )
+        if deadline_s is not None:
+            self.metrics.admission(verdict_name)
+        if verdict_name == "infeasible":
+            self.rejected += 1
+            self.metrics.reject("deadline_infeasible", op=kind,
+                                client=client)
+            self.event("service", event="reject",
+                        reason="deadline_infeasible",
+                        queue_depth=self._sched.qsize(), tenant=client)
+            self._reply_and_close(
+                f, conn,
+                {"ok": False, "rejected": "deadline_infeasible",
+                 "est": est},
             )
             return
         # mint the id BEFORE spooling so the lifecycle path can stamp
         # admitted → spooled → queued in true order
         rid = rid or _protocol.mint_request_id()
-        path = self.metrics.admit(rid, op=kind, client=client)
+        path = self.metrics.admit(rid, op=kind, client=client,
+                                  priority=priority)
         # spool FIRST, queue second: a crash between the two replays the
         # request on resume; the reverse would acknowledge lost work
         try:
@@ -539,24 +652,43 @@ class SimulationService:
         self.event(
             "request", event="admitted", id=rid,
             kind=kind,
-            cells=len(request.get("cells") or []),
+            cells=n_cells,
+            client=client, priority=priority,
+            **(
+                {"admission": verdict_name, "deadline_s": deadline_s,
+                 **({"est_s": est["est_s"]} if est else {})}
+                if deadline_s is not None else {}
+            ),
         )
-        if msg.get("wait", True):
-            self._queue.put((rid, request, (f, conn)))
-        else:
-            self._queue.put((rid, request, None))
+        waiter = (f, conn) if msg.get("wait", True) else None
+        self._sched.put(_scheduler.ScheduledRequest(
+            request_id=rid, request=request, waiter=waiter,
+            tenant=client, priority=priority, affinity=affinity,
+            est_s=(est or {}).get("est_s"),
+        ))
+        if waiter is None:
             self._reply_and_close(
                 f, conn, {"ok": True, "status": "accepted", "id": rid}
             )
         path.stamp("queued")
-        self.metrics.queue_depth(self._queue.qsize())
+        self.metrics.queue_depth(self._sched.qsize(),
+                                 by_class=self._sched.depth_by_class())
 
     # -- worker ----------------------------------------------------------------
 
-    def _execute(self, rid: str, request: Dict[str, Any]) -> Dict[str, Any]:
+    def _execute(
+        self,
+        rid: str,
+        request: Dict[str, Any],
+        sched_entry: Optional["_scheduler.ScheduledRequest"] = None,
+    ) -> Dict[str, Any]:
         """One request through the resilient ladder; returns the reply.
         Never raises — a failure to even build the request becomes an
-        ``error`` reply, not a dead server."""
+        ``error`` reply, not a dead server. With a ``sched_entry``, the
+        ladder yields at cell boundaries when strictly-higher-priority
+        work waits (the reply's ``status`` becomes ``"preempted"`` and
+        the worker requeues the entry — the journal makes the next slice
+        resume content-identically)."""
         # the ladder imports stay function-scope so importing
         # blades_tpu.service is pre-jax clean; the ladder itself is
         # stdlib on the probe path (resilient.py lazy-imports the
@@ -564,10 +696,7 @@ class SimulationService:
         from blades_tpu.service import handlers as _handlers
         from blades_tpu.sweeps import program_fingerprint
         from blades_tpu.sweeps.journal import SweepJournal
-        from blades_tpu.sweeps.resilient import (
-            ResilienceOptions,
-            run_cells_resilient,
-        )
+        from blades_tpu.sweeps.resilient import ResilienceOptions
 
         t0 = time.perf_counter()
         with self._state_lock:
@@ -591,8 +720,20 @@ class SimulationService:
                 "cells": len(request.get("cells") or []),
             },
         )
+        # the cache exists BEFORE plan-build: sweep plans capture it at
+        # build time (chaos cells share warm engines across requests)
+        if self._engine_cache is None:
+            from blades_tpu.sweeps import EngineCache
+
+            self._engine_cache = EngineCache()
+        ctx = {
+            "cache": self._engine_cache,
+            "datasets": self._datasets,
+            "out_dir": self.out_dir,
+            "request_id": rid,
+        }
         try:
-            cells = _handlers.build_cells(request)
+            plan = _handlers.build_plan(request, ctx)
         except (ValueError, TypeError) as e:
             self.failed += 1
             error = f"{type(e).__name__}: {e}"[:300]
@@ -603,17 +744,15 @@ class SimulationService:
             entry.ended("crashed", error=error)
             return {"ok": False, "id": rid, "status": "error",
                     "error": error}
+        labels = plan.labels
         self.event(
             "request", event="started", id=rid,
-            kind=str(request.get("kind")), cells=len(cells),
+            kind=str(request.get("kind")), cells=len(labels),
             **({"queue_age_s": round(queue_age, 3)}
                if queue_age is not None else {}),
         )
-        if self._engine_cache is None:
-            from blades_tpu.sweeps import EngineCache
-
-            self._engine_cache = EngineCache()
-        # per-request journal: completed cells survive SIGKILL; the
+        # per-request journal: completed cells survive SIGKILL (and a
+        # preemption — a requeued slice resumes from it); the
         # fingerprint guard keys on the request body, so a resumed id
         # whose spooled body somehow drifted starts clean instead of
         # stitching two different requests into one reply
@@ -624,28 +763,50 @@ class SimulationService:
             }),
             resume=True,
         )
-        resumed_cells = sum(1 for lab, _ in cells if journal.has(lab))
+        resumed_cells = sum(1 for lab in labels if journal.has(lab))
         if resumed_cells:
             self.resumed_requests += 1
-        acct = _RequestAccounting(self, rid, total=len(cells))
-        runner = _handlers.make_runner(request, {
-            "cache": self._engine_cache,
-            "datasets": self._datasets,
-            "out_dir": self.out_dir,
-            "request_id": rid,
-        })
+        acct = _RequestAccounting(self, rid, total=len(labels))
+        opt_kw: Dict[str, Any] = {
+            "attempts": self.attempts,
+            "base_delay_s": self.base_delay_s,
+            "cell_deadline_s": self.cell_deadline_s,
+        }
+        opt_kw.update(plan.resilience_kw or {})
+        if sched_entry is not None:
+            # cell-boundary preemption: the ladder polls between cells;
+            # strictly-higher-priority waiting work wins the slot
+            prio = sched_entry.priority
+            opt_kw["should_yield"] = (
+                lambda: self._sched.waiting_above(prio)
+            )
+        options = ResilienceOptions(**opt_kw)
         try:
-            results, _, report = run_cells_resilient(
-                cells,
-                runner,
-                sweep=acct,
-                journal=journal,
-                options=ResilienceOptions(
-                    attempts=self.attempts,
-                    base_delay_s=self.base_delay_s,
-                    cell_deadline_s=self.cell_deadline_s,
-                ),
-                kind="service",
+            results, walls, report = plan.execute(
+                sweep=acct, journal=journal, options=options,
+            )
+            if report.preempted:
+                wall = time.perf_counter() - t0
+                self.event(
+                    "request", event="preempted", id=rid,
+                    kind=str(request.get("kind")), cells=len(labels),
+                    executed=report.executed,
+                    resumed_cells=report.resumed_skipped,
+                    preemptions=(sched_entry.preemptions + 1
+                                 if sched_entry else 1),
+                    wall_s=round(wall, 6),
+                )
+                entry.ended("finished", metrics={
+                    "preempted": 1, "executed": report.executed,
+                })
+                # the lifecycle path stays OPEN: the next slice re-calls
+                # path.start() (first-wins stamps keep the true start)
+                # and metrics.finish closes it when the request is done
+                return {"ok": True, "id": rid, "status": "preempted",
+                        "executed": report.executed}
+            extra = (
+                plan.finalize(results, walls, report)
+                if plan.finalize else {}
             )
         except Exception as e:  # noqa: BLE001 - isolation: reply, don't die
             self.failed += 1
@@ -661,7 +822,7 @@ class SimulationService:
             journal.close()
         quarantined = {q["cell"]: q for q in report.quarantined}
         out_cells: List[Dict[str, Any]] = []
-        for (label, _), res in zip(cells, results):
+        for label, res in zip(labels, results):
             if res is None:
                 q = quarantined.get(label, {})
                 out_cells.append({
@@ -670,6 +831,11 @@ class SimulationService:
                     "error": q.get("error", "quarantined"),
                     "error_type": q.get("error_type", "Exception"),
                 })
+            elif plan.slim_cells:
+                # driver plans (certify/chaos) return their result via
+                # finalize()'s assembled artifact; per-cell payloads
+                # would bloat the spooled reply with redundant rows
+                out_cells.append({"label": label})
             else:
                 out_cells.append({"label": label, "result": res})
         wall = time.perf_counter() - t0
@@ -677,6 +843,8 @@ class SimulationService:
         if quarantined:
             self.quarantined_requests += 1
         self.served += 1
+        client = path.client
+        priority = path.priority
         # close the lifecycle path: the finished record carries the
         # queue-wait / build / execute split (it tiles total_s) and the
         # warm/cold classification alongside the execution wall
@@ -686,14 +854,20 @@ class SimulationService:
         )
         self.event(
             "request", event="finished", id=rid, outcome=outcome,
-            cells=len(cells), executed=report.executed,
+            cells=len(labels), executed=report.executed,
             resumed_cells=report.resumed_skipped,
             quarantined=len(quarantined), retried=report.retried,
+            client=client, priority=priority,
+            **(
+                {"preemptions": sched_entry.preemptions}
+                if sched_entry is not None and sched_entry.preemptions
+                else {}
+            ),
             wall_s=round(wall, 6),
             **split,
         )
         entry.ended("finished", metrics={
-            "cells": len(cells),
+            "cells": len(labels),
             "executed": report.executed,
             "resumed_cells": report.resumed_skipped,
             "quarantined": len(quarantined),
@@ -706,15 +880,15 @@ class SimulationService:
             "kind": request.get("kind"),
             "cells": out_cells,
             "summary": report.summary(),
+            **extra,
         }
 
     def _work(self) -> Dict[str, Any]:
         while True:
-            try:
-                rid, request, waiter = self._queue.get(timeout=self.poll_s)
-            except queue.Empty:
+            entry_obj = self._sched.pick(timeout=self.poll_s)
+            if entry_obj is None:
                 self._beat_idle()
-                if self._draining.is_set() and self._queue.empty():
+                if self._draining.is_set() and self._sched.empty():
                     # zero-lost-requests on drain needs ordering, not
                     # luck: a listener mid-_admit may have passed its
                     # draining check and be about to spool+queue one
@@ -724,13 +898,41 @@ class SimulationService:
                     # admit is in the queue now and loops back into
                     # execution; only a truly empty queue exits.
                     self._shutdown_listener()
-                    if self._queue.empty():
+                    if self._sched.empty():
                         break
                 continue
+            rid = entry_obj.request_id
+            request = entry_obj.request
             with self._state_lock:
                 self._in_flight = rid
                 self._in_flight_since = time.time()
-            reply = self._execute(rid, request)
+            slice_t0 = time.monotonic()
+            reply = self._execute(rid, request, sched_entry=entry_obj)
+            # fair-share charges the tenant for the slice it actually
+            # consumed — a preempted slice still cost its wall
+            self._sched.charge(entry_obj.tenant,
+                               time.monotonic() - slice_t0)
+            if reply.get("status") == "preempted":
+                # the request is NOT done: requeue it (same seq — it
+                # keeps its place among equals), keep the spool entry
+                # pending and the waiter riding on the entry. The
+                # higher-priority work that triggered the yield is
+                # picked next.
+                self.preemptions += 1
+                self.metrics.preempted(rid)
+                with self._state_lock:
+                    self._in_flight = None
+                    self._in_flight_since = None
+                self._sched.requeue(entry_obj)
+                self.metrics.queue_depth(
+                    self._sched.qsize(),
+                    by_class=self._sched.depth_by_class(),
+                )
+                continue
+            # warm-first bookkeeping: this body's engines are now built;
+            # a repeat body is scheduled as warm by the estimator
+            self._sched.note_warm(entry_obj.affinity)
+            self._sched.done(entry_obj)
             # spool before replying: the reply must be fetchable (op:
             # result) even if the waiting client died with the connection
             self.spool.complete(rid, reply)
@@ -738,8 +940,8 @@ class SimulationService:
                 self._in_flight = None
                 self._in_flight_since = None
                 self._pending_ts.pop(rid, None)
-            if waiter is not None:
-                f, conn = waiter
+            if entry_obj.waiter is not None:
+                f, conn = entry_obj.waiter
                 self._reply_and_close(f, conn, reply)
             self._health()
         return self._snapshot()
@@ -797,22 +999,39 @@ class SimulationService:
         # resume BEFORE listening: the interrupted lifetime's requests go
         # to the head of the queue, then new admissions line up behind
         pending = self.spool.pending() if self.resume else []
+        if pending:
+            from blades_tpu.sweeps import program_fingerprint
         for rid, request in pending:
             with self._state_lock:
                 self._pending_ts[rid] = time.time()
+            try:
+                client = safe_name(request.get("client") or "anon",
+                                   "client label")
+            except ValueError:
+                client = "anon"
+            priority = request.get("priority") or "normal"
+            if priority not in _scheduler.PRIORITIES:
+                priority = "normal"
             # a resumed request's lifecycle restarts at the relaunch:
             # queue-wait measures THIS attempt's wait, not the outage
             path = self.metrics.admit(
-                rid, op=str(request.get("kind")),
-                client=str(request.get("client") or "anon"),
+                rid, op=str(request.get("kind")), client=client,
+                priority=priority,
             )
             path.stamp("spooled")
-            self._queue.put((rid, request, None))
+            self._sched.put(_scheduler.ScheduledRequest(
+                request_id=rid, request=request, waiter=None,
+                tenant=client, priority=priority,
+                affinity=program_fingerprint(request={
+                    k: v for k, v in request.items() if k != "id"
+                }),
+            ))
             path.stamp("queued")
-        self.metrics.queue_depth(self._queue.qsize())
+        self.metrics.queue_depth(self._sched.qsize(),
+                                 by_class=self._sched.depth_by_class())
         self.event(
             "service", event="start", socket=self.socket_path,
-            queue_depth=self._queue.qsize(),
+            queue_depth=self._sched.qsize(),
             resumed=len(pending), pid=os.getpid(),
         )
 

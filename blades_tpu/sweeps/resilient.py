@@ -151,7 +151,17 @@ class ResilienceOptions:
     ``cell_deadline_s`` scales with the subgroup: a group of C cells
     gets ``C x cell_deadline_s``. ``sleep`` and ``runner`` are test
     injection points (``runner(group, key)`` replaces the real batched
-    execution)."""
+    execution).
+
+    ``should_yield``: polled at cell (per-cell executor) or group
+    (batched executor) boundaries AFTER at least one unit of progress;
+    a ``True`` stops the sweep with ``report.preempted`` set and every
+    remaining slot ``None`` — the caller requeues and a later execution
+    recovers the journaled cells and runs only the remainder (the
+    service scheduler's cell-boundary preemption,
+    ``blades_tpu/service/scheduler.py``). The one-unit-of-progress
+    floor makes preemption livelock-free by construction: every slice
+    completes at least one journaled cell."""
 
     attempts: int = 2
     base_delay_s: float = 0.5
@@ -159,6 +169,7 @@ class ResilienceOptions:
     cell_deadline_s: Optional[float] = None
     sleep: Callable[[float], None] = time.sleep
     runner: Optional[Callable[[Sequence[SweepCell], str], list]] = None
+    should_yield: Optional[Callable[[], bool]] = None
 
     def __post_init__(self):
         # a non-positive budget would skip the attempt loop entirely and
@@ -178,6 +189,10 @@ class ResilienceReport:
     degraded_groups: int = 0
     executed: int = 0
     resumed_skipped: int = 0
+    #: the sweep stopped at a cell/group boundary because
+    #: ``options.should_yield`` asked it to; remaining slots are None
+    #: and NOT quarantined — a later execution finishes them
+    preempted: bool = False
     quarantined: List[Dict[str, Any]] = dataclasses.field(
         default_factory=list
     )
@@ -331,11 +346,27 @@ def run_cells_resilient(
     walls: List[float] = []
     report = ResilienceReport()
 
+    progressed = 0
     for label, payload in cells:
         if journal is not None and journal.has(label):
             result, wall = _recover_cell(journal, sweep, report, label)
             results.append(result)
             walls.append(wall)
+            continue
+
+        # cell-boundary preemption: yield only after at least one cell
+        # of NEW work this invocation (journal recoveries don't count —
+        # a slice must always advance the journal, or back-to-back
+        # preemptions could spin without progress). Remaining slots pad
+        # to None so drivers keep positional alignment.
+        if report.preempted or (
+            progressed
+            and options.should_yield is not None
+            and options.should_yield()
+        ):
+            report.preempted = True
+            results.append(None)
+            walls.append(0.0)
             continue
 
         ok = False
@@ -376,6 +407,7 @@ def run_cells_resilient(
             )
             results.append(None)
             walls.append(wall)
+            progressed += 1
             continue
 
         if journal is not None:
@@ -386,6 +418,7 @@ def run_cells_resilient(
         results.append(out)
         walls.append(wall)
         report.executed += 1
+        progressed += 1
 
     return results, walls, report
 
@@ -525,6 +558,7 @@ def run_grouped_resilient(
             return
         _commit(idxs, outs, wall, delta, key, retries_used)
 
+    progressed = 0
     for key, idxs in plan_groups(cells):
         pending: List[int] = []
         for i in idxs:
@@ -535,7 +569,20 @@ def run_grouped_resilient(
                 )
             else:
                 pending.append(i)
-        if pending:
-            _solve(pending, key, options.attempts)
+        if not pending:
+            continue
+        # group-boundary preemption (same contract as the per-cell
+        # executor): yield between journaled groups after at least one
+        # group of new work; remaining slots stay None for the caller
+        # to resume via the journal
+        if report.preempted or (
+            progressed
+            and options.should_yield is not None
+            and options.should_yield()
+        ):
+            report.preempted = True
+            continue
+        _solve(pending, key, options.attempts)
+        progressed += 1
 
     return results, walls, report

@@ -130,6 +130,7 @@ API_MODULES = [
     "blades_tpu.service.protocol",
     "blades_tpu.service.spool",
     "blades_tpu.service.handlers",
+    "blades_tpu.service.scheduler",
     "blades_tpu.leaf",
     "blades_tpu.leaf.preprocess",
 ]

@@ -5,7 +5,10 @@ Starts the long-lived simulation service (``blades_tpu/service``,
 its unix-domain socket:
 
 1. a ``probe`` request — stdlib-only cells, served before jax is even
-   imported in the server (health checks and chaos drills use these);
+   imported in the server (health checks and chaos drills use these) —
+   then the same with a tenant label, a priority class, and a deadline
+   (the PR 17 multi-tenant scheduler: fair-share across tenants,
+   deadline-aware admission, cell-boundary preemption);
 2. a ``probe`` request carrying a poison cell — quarantined with an
    attributable error while its sibling cells complete (the PR 13
    resilient ladder, request-scoped);
@@ -78,6 +81,18 @@ def _drive(client, args) -> None:
     ]})
     print("probe ->", json.dumps(probe["cells"]))
 
+    # multi-tenant scheduling (blades_tpu/service/scheduler.py): requests
+    # carry a tenant label, a priority class, and optionally a deadline —
+    # the scheduler fair-shares tenants, preempts batch work at cell
+    # boundaries for interactive requests, and rejects deadlines it
+    # cannot meet (`rejected: deadline_infeasible`) before spooling
+    tenant = client.submit(
+        {"kind": "probe",
+         "cells": [{"label": "urgent", "op": "ok", "value": 7}]},
+        client="alice", priority="interactive", deadline_s=30.0,
+    )
+    print("tenant probe ->", json.dumps(tenant["cells"]))
+
     poison = client.submit({"kind": "probe", "cells": [
         {"label": "good", "op": "ok", "value": 1},
         {"label": "bad", "op": "fail", "message": "intentionally poisoned"},
@@ -109,6 +124,9 @@ def _drive(client, args) -> None:
     print("metrics -> warm={warm} cold={cold}".format(**metrics["requests"]))
     print(f"metrics -> queue_wait_share={split['queue_wait_share']}, "
           f"warm p99 <= {metrics['latency']['warm'].get('p99_s')}s")
+    # the scheduler rollup: preemptions taken, admission verdicts, and
+    # the per-priority-class queue-depth high-water marks
+    print("metrics -> sched =", json.dumps(metrics["sched"]))
 
 
 if __name__ == "__main__":

@@ -85,11 +85,50 @@ def total_cells(args) -> int:
     """Upfront cell count for the sweep accounting's i-of-N / ETA: one
     contract-battery cell per aggregator, one breakdown cell per
     (aggregator, f), and two staleness scenarios per breakdown cell
-    unless ``--no-async``."""
+    unless ``--no-async``. Stdlib-only — the service's admission
+    estimator calls this pre-jax (``blades_tpu/service/handlers.py``)."""
     names = tuple(args.aggs) if args.aggs else CERT_POOL
     f_cells = (args.clients - 1) // 2 + 1
     per_f = 1 + (0 if args.no_async else 2)
     return len(names) * (1 + f_cells * per_f)
+
+
+#: the full knob set a service ``sweep`` request's ``spec`` body may
+#: carry — exactly the argparse surface below, same defaults, so a spec
+#: submitted over the socket and a CLI invocation enumerate the same
+#: cells (and the same journal fingerprint covers both)
+SPEC_DEFAULTS = {
+    "clients": 8, "dim": 32, "trials": 3, "seed": 0, "c": None,
+    "aggs": None, "quick": False, "no_async": False, "tau_max": 3,
+    "no_jit": False, "sequential": False, "attempts": 2,
+    "cell_deadline": None,
+}
+
+
+def spec_namespace(spec) -> argparse.Namespace:
+    """An argparse-equivalent namespace from a service ``sweep``
+    request's ``spec`` dict. Stdlib-only and jax-free: the server calls
+    this at ADMISSION (for the cell-count estimate) on the pre-jax
+    listener path. Unknown keys are a ``ValueError`` — a typo'd knob
+    must reject the request, not silently run the default matrix."""
+    spec = dict(spec or {})
+    unknown = sorted(set(spec) - set(SPEC_DEFAULTS))
+    if unknown:
+        raise ValueError(f"unknown certify spec keys: {unknown}")
+    merged = {**SPEC_DEFAULTS, **spec}
+    for k in ("clients", "dim", "trials", "seed", "tau_max", "attempts"):
+        merged[k] = int(merged[k])
+    for k in ("quick", "no_async", "no_jit", "sequential"):
+        merged[k] = bool(merged[k])
+    if merged["c"] is not None:
+        merged["c"] = float(merged["c"])
+    if merged["cell_deadline"] is not None:
+        merged["cell_deadline"] = float(merged["cell_deadline"])
+    if merged["aggs"] is not None:
+        merged["aggs"] = [str(a) for a in merged["aggs"]]
+    if merged["clients"] < 2 or merged["dim"] < 1 or merged["trials"] < 1:
+        raise ValueError("certify spec needs clients>=2, dim>=1, trials>=1")
+    return argparse.Namespace(**merged)
 
 
 def _cell_row(name, f, f_nom, cell, c, search_s) -> dict:
@@ -152,60 +191,56 @@ def certify_matrix(args, sweep=None, journal=None, resilience=None) -> dict:
     ``BLADES_RESUME=1`` relaunch — the resumed matrix merges journaled
     and freshly-executed cells into content identical (modulo the
     timing fields) to an uninterrupted run (``tests/test_resilient.py``).
+
+    Decomposed into :func:`enumerate_cells` -> :func:`execute_cells` ->
+    :func:`assemble_matrix` so the simulation service can run the SAME
+    sweep as a ``sweep`` request kind (``blades_tpu/service/handlers
+    .py``): enumeration yields the labels the journal/spool need,
+    execution accepts the server's resilient options (including the
+    scheduler's cell-boundary ``should_yield`` preemption hook), and
+    assembly is deferred until a possibly-preempted-and-resumed request
+    has actually executed every cell.
     """
+    plans, specs = enumerate_cells(args)
+    results, walls, report = execute_cells(
+        args, plans, specs, sweep=sweep, journal=journal,
+        resilience=resilience,
+    )
+    return assemble_matrix(args, plans, specs, results, walls, report)
+
+
+def _grids(args):
+    from blades_tpu.audit import DEFAULT_GRIDS, QUICK_GRIDS
+
+    return QUICK_GRIDS if args.quick else DEFAULT_GRIDS
+
+
+def enumerate_cells(args):
+    """Every attack-search cell of the matrix as ``(plans, specs)``:
+    ``specs`` the :class:`~blades_tpu.sweeps.SweepCell` list the executor
+    consumes, ``plans`` the parallel assembly directives
+    (``(kind, name, agg, f_nom, f, extra)``). Deterministic in ``args``
+    (seeded PRNG) — a resumed or service-routed run re-enumerates the
+    identical list, which is what keeps journal labels stable across
+    attempts and preemption slices."""
     import jax
 
     from blades_tpu.audit import (
-        DEFAULT_C,
-        DEFAULT_GRIDS,
-        QUICK_GRIDS,
         battery_ctx,
         battery_search_inputs,
         nominal_f,
-        resilience_from_cell,
-        run_battery,
-        search_cell,
-        search_cell_staleness,
         staleness_row_weights,
         synthetic_honest,
     )
     from blades_tpu.sweeps import SweepCell
-    from blades_tpu.sweeps.resilient import (
-        ResilienceOptions,
-        run_cells_resilient,
-        run_grouped_resilient,
-    )
 
     k, d, trials = args.clients, args.dim, args.trials
-    grids = QUICK_GRIDS if args.quick else DEFAULT_GRIDS
-    c = args.c if args.c is not None else DEFAULT_C
-    f_max = (k - 1) // 2
     names = tuple(args.aggs) if args.aggs else CERT_POOL
-    sequential = bool(getattr(args, "sequential", False))
+    f_max = (k - 1) // 2
 
     key = jax.random.PRNGKey(args.seed)
     trials_updates = synthetic_honest(key, trials, k, d)
     ctx = battery_ctx(None, k, d, key=jax.random.fold_in(key, 1))
-
-    # sweep accounting (telemetry/timeline.py): every cell below lands as
-    # one per-cell `sweep` record (wall/compile/execute split, i-of-N,
-    # ETA) flushed at the cell (or batched-group) boundary, plus a
-    # heartbeat touch so a supervised sweep stays visibly alive. A None
-    # sweep (library callers, tests) degrades to a no-op.
-    if sweep is None:
-        from contextlib import nullcontext
-
-        class _NullSweep:
-            def cell(self, key_, **kw):
-                return nullcontext()
-
-            def record(self, key_, wall_s, counter_delta=None, **kw):
-                pass
-
-            def resume(self, skipped, journal=None, quarantined=0):
-                pass
-
-        sweep = _NullSweep()
 
     scenarios = () if args.no_async else (
         ("fresh_byz", 0), ("stale_byz", args.tau_max),
@@ -264,8 +299,49 @@ def certify_matrix(args, sweep=None, journal=None, resilience=None) -> dict:
                     label=f"{name}/f{f}/{scenario}", agg=agg_f,
                     trials=weighted, f=f, ctx=ctx, part_mask=part,
                 ))
+    return plans, specs
 
-    # -- execute --------------------------------------------------------------
+
+def execute_cells(args, plans, specs, sweep=None, journal=None,
+                  resilience=None):
+    """Run the enumerated cells under the resilient executor and return
+    its raw ``(results, walls, report)``. The service's ``sweep``
+    request kind calls this with its own journal/accounting and a
+    ``resilience`` carrying the scheduler's ``should_yield`` hook — a
+    preempted run returns ``report.preempted`` with the unexecuted tail
+    padded to ``None``, and the caller must NOT assemble from it."""
+    import jax
+
+    from blades_tpu.audit import search_cell, search_cell_staleness
+    from blades_tpu.sweeps.resilient import (
+        ResilienceOptions,
+        run_cells_resilient,
+        run_grouped_resilient,
+    )
+
+    grids = _grids(args)
+    sequential = bool(getattr(args, "sequential", False))
+
+    # sweep accounting (telemetry/timeline.py): every cell below lands as
+    # one per-cell `sweep` record (wall/compile/execute split, i-of-N,
+    # ETA) flushed at the cell (or batched-group) boundary, plus a
+    # heartbeat touch so a supervised sweep stays visibly alive. A None
+    # sweep (library callers, tests) degrades to a no-op.
+    if sweep is None:
+        from contextlib import nullcontext
+
+        class _NullSweep:
+            def cell(self, key_, **kw):
+                return nullcontext()
+
+            def record(self, key_, wall_s, counter_delta=None, **kw):
+                pass
+
+            def resume(self, skipped, journal=None, quarantined=0):
+                pass
+
+        sweep = _NullSweep()
+
     # resume: the resume record leads the attempt's trace, so every
     # later non-``resumed`` sweep record is a genuinely executed cell —
     # the pin the kill->relaunch e2e asserts (tests/test_resilient.py)
@@ -284,6 +360,15 @@ def certify_matrix(args, sweep=None, journal=None, resilience=None) -> dict:
         cell_deadline_s=getattr(args, "cell_deadline", None),
     )
     if sequential:
+        # the sequential path re-derives the enumeration's shared inputs
+        # (deterministic in the seed) — search_cell_staleness applies the
+        # staleness weighting itself, so it needs the RAW honest trials
+        from blades_tpu.audit import battery_ctx, synthetic_honest
+
+        k, d, trials = args.clients, args.dim, args.trials
+        key = jax.random.PRNGKey(args.seed)
+        trials_updates = synthetic_honest(key, trials, k, d)
+        ctx = battery_ctx(None, k, d, key=jax.random.fold_in(key, 1))
         # one program per cell: each cell is already its own execution
         # unit, so the shared per-cell resilient loop (retry -> soft
         # deadline -> quarantine, journal recovery) applies directly —
@@ -317,6 +402,29 @@ def certify_matrix(args, sweep=None, journal=None, resilience=None) -> dict:
             specs, grids=grids, use_jit=not args.no_jit, sweep=sweep,
             journal=journal, options=options,
         )
+    return results, walls, report
+
+
+def assemble_matrix(args, plans, specs, results, walls, report) -> dict:
+    """The committed matrix dict from the executor's raw output —
+    identical row order and content whether the cells ran batched,
+    sequential, resumed, or service-routed. Runs the contract battery
+    for each aggregator here (it consumes the already-executed
+    resilience cell), so callers holding a PREEMPTED report must defer
+    to a resumed completion instead of assembling."""
+    from blades_tpu.audit import (
+        DEFAULT_C,
+        nominal_f,
+        resilience_from_cell,
+        run_battery,
+    )
+
+    k, d, trials = args.clients, args.dim, args.trials
+    grids = _grids(args)
+    c = args.c if args.c is not None else DEFAULT_C
+    f_max = (k - 1) // 2
+    names = tuple(args.aggs) if args.aggs else CERT_POOL
+    sequential = bool(getattr(args, "sequential", False))
 
     # -- assemble (identical row order and content either way) ----------------
     qinfo = {q["cell"]: q for q in report.quarantined}
@@ -439,6 +547,63 @@ def certify_matrix(args, sweep=None, journal=None, resilience=None) -> dict:
     return matrix
 
 
+def _main_via_service(args) -> int:
+    """Route the matrix through a running simulation service as a
+    ``sweep`` request — the certification driver as a real TENANT:
+    client label ``certify``, priority ``batch``, journaled under the
+    request's own ``SweepJournal`` on the server, preemptible at cell
+    boundaries by higher-priority work and resumed content-identically.
+    Same one-JSON-line contract as the in-process path."""
+    try:
+        from blades_tpu.service.client import ServiceClient
+
+        spec = {
+            key: getattr(args, key) for key in SPEC_DEFAULTS
+            if getattr(args, key) != SPEC_DEFAULTS[key]
+        }
+        request = {
+            "kind": "sweep", "sweep": "certify", "spec": spec,
+            "client": "certify", "priority": "batch",
+        }
+        client = ServiceClient(args.via_service)
+        reply = client.submit(request, timeout=args.service_timeout)
+        matrix = (reply.get("sweep") or {}).get("matrix")
+        if not reply.get("ok") or matrix is None:
+            print(json.dumps({
+                "metric": METRIC, "via_service": True, "ok": False,
+                "id": reply.get("id"),
+                "error": str(reply.get("error")
+                             or reply.get("reason") or reply)[:1000],
+            }))
+            return 1
+        os.makedirs(args.out, exist_ok=True)
+        artifact = os.path.join(args.out, "cert_matrix.json")
+        with open(artifact, "w") as fh:
+            json.dump(matrix, fh, indent=1)
+            fh.write("\n")
+        print(json.dumps({
+            "metric": METRIC,
+            "via_service": True,
+            "id": reply.get("id"),
+            "cells": len(matrix["cells"]),
+            "async_cells": len(matrix["async_cells"]),
+            "headline_failures": matrix["headline_failures"],
+            "quarantined": [r["cell"] for r in matrix["quarantined_cells"]],
+            "resumed_skipped": matrix["resumed_skipped"],
+            "artifact": os.path.relpath(artifact, REPO),
+            "ok": matrix["ok"],
+        }))
+        return 0 if matrix["ok"] else 1
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 - one-JSON-line contract
+        print(json.dumps({
+            "metric": METRIC, "via_service": True, "ok": False,
+            "error": f"{type(e).__name__}: {e}"[:1000],
+        }))
+        return 1
+
+
 def main() -> int:
     """One-JSON-line contract, unconditionally (the ``bench.py``
     discipline): even a bug in the sweep must reach the driver as a single
@@ -478,7 +643,19 @@ def main() -> int:
                         "heartbeat watchdog stays the hard kill layer")
     p.add_argument("--out", default=os.path.join(REPO, "results",
                                                  "certification"))
+    p.add_argument("--via-service", default=None, metavar="SOCK",
+                   help="submit the matrix as a `sweep` request to a "
+                        "running simulation service (scripts/serve.py) "
+                        "instead of executing in-process — the sweep "
+                        "runs as a batch-priority tenant of the "
+                        "multi-tenant scheduler, preemptible at cell "
+                        "boundaries by interactive work")
+    p.add_argument("--service-timeout", type=float, default=3600.0,
+                   help="--via-service reply wait bound (seconds)")
     args = p.parse_args()
+
+    if args.via_service:
+        return _main_via_service(args)
 
     # run identity + ledger (stdlib-only): the cert matrix is a committed
     # evidence artifact — make the run that produced it addressable

@@ -515,6 +515,14 @@ def sweep(
         ),
         kind="chaos",
     )
+    return summarize_rows(n, rows, report, cache.stats())
+
+
+def summarize_rows(n: int, rows, report, cache_stats) -> dict:
+    """The sweep summary dict from the resilient executor's raw output —
+    shared by the in-process :func:`sweep` and the simulation service's
+    ``sweep`` request kind (``blades_tpu/service/handlers.py``), so a
+    service-routed chaos sweep reports the identical evidence shape."""
     results = [r for r in rows if r is not None]
     violations = [
         f"seed {row['seed']}: {msg}"
@@ -534,7 +542,7 @@ def sweep(
         "async_scenarios": sum(r["async"] is not None for r in results),
         # warm-program reuse: twin/block reruns served from the engine
         # cache (blades_tpu/sweeps) — hits are trace+compiles NOT paid
-        "engine_cache": cache.stats(),
+        "engine_cache": cache_stats,
         # resilient-execution accounting: a resumed/degraded sweep must
         # be distinguishable from a clean one
         "resumed_skipped": report.resumed_skipped,
@@ -855,12 +863,139 @@ def _scn_sigkill_resume(out_dir: str) -> dict:
     }
 
 
+def _scn_tenant_flood(out_dir: str) -> dict:
+    """A queue-flooding hostile tenant is contained by its per-tenant
+    quota: every backpressure reject NAMES the flooder, the victim's
+    interactive request completes within its SLO with zero rejections,
+    and the per-tenant rejected counters equal the per-tenant
+    backpressure replies the clients saw."""
+    import time as _time
+
+    proc, client = _start_server(
+        os.path.join(out_dir, "flood"),
+        ("--max-queue", "8", "--tenant-quota", "2"),
+    )
+    try:
+        busy = client.submit(
+            {"kind": "probe",
+             "cells": [{"label": "s", "op": "sleep", "sleep_s": 1.5}]},
+            wait=False, client="flood", priority="batch",
+        )
+        _time.sleep(0.2)  # let the worker pick the sleeper up
+        flood_replies = [
+            client.submit(
+                {"kind": "probe",
+                 "cells": [{"label": f"f{i}", "op": "ok", "value": i}]},
+                wait=False, client="flood", priority="batch",
+            )
+            for i in range(5)
+        ]
+        rejects = [r for r in flood_replies if r.get("rejected")]
+        t0 = _time.monotonic()
+        victim = client.submit(
+            {"kind": "probe",
+             "cells": [{"label": "v", "op": "ok", "value": 42}]},
+            client="victim", priority="interactive", timeout=60,
+        )
+        victim_wall = _time.monotonic() - t0
+        # containment: the quota sheds the flooder's excess, never the
+        # victim — and every reject is attributed to the flooder
+        rejects_attributed = all(
+            r.get("rejected") == "backpressure"
+            and r.get("tenant") == "flood"
+            and r.get("scope") == "tenant"
+            for r in rejects
+        )
+        metrics = client.metrics()
+        by_client = metrics.get("by_client") or {}
+        flood_m = by_client.get("flood") or {}
+        victim_m = by_client.get("victim") or {}
+        # invariant: per-tenant rejected counters == per-tenant
+        # backpressure replies (flood absorbs all of them, victim zero)
+        metrics_consistent = (
+            flood_m.get("rejected") == len(rejects)
+            and victim_m.get("rejected", 0) == 0
+        )
+        ok = (
+            busy.get("status") == "accepted"
+            and len(rejects) >= 1
+            and rejects_attributed
+            and victim.get("ok")
+            and victim_wall < 20.0  # SLO: generous for the 1-core box
+            and metrics_consistent
+        )
+        return {"name": "tenant_flood", "ok": bool(ok),
+                "flood_submitted": len(flood_replies) + 1,
+                "flood_rejected": len(rejects),
+                "rejects_attributed": bool(rejects_attributed),
+                "victim_wall_s": round(victim_wall, 3),
+                "victim_rejected": victim_m.get("rejected", 0),
+                "metrics_consistent": bool(metrics_consistent)}
+    finally:
+        _finish_server(proc, client)
+
+
+def _scn_preempt_resume(out_dir: str) -> dict:
+    """A long batch request yields at a cell boundary to interactive
+    work, is requeued, resumes from its journal, and its merged reply is
+    content-identical to an uninterrupted run of the same request."""
+    import time as _time
+
+    request = {"kind": "probe", "cells": [
+        {"label": f"c{i}", "op": "sleep", "sleep_s": 0.3, "value": i}
+        for i in range(6)
+    ]}
+    # reference: the same request on an idle server (no preemption)
+    ref_dir = os.path.join(out_dir, "preempt_ref")
+    proc, client = _start_server(ref_dir)
+    try:
+        ref = client.submit(request, request_id="preempt-ref",
+                            client="batcher", priority="batch", timeout=60)
+    finally:
+        _finish_server(proc, client)
+
+    proc, client = _start_server(os.path.join(out_dir, "preempt"))
+    try:
+        batch = client.submit(request, request_id="preempt-main",
+                              wait=False, client="batcher",
+                              priority="batch")
+        _time.sleep(0.5)  # the worker is mid-sweep when interactive lands
+        inter = client.submit(
+            {"kind": "probe",
+             "cells": [{"label": "i", "op": "ok", "value": 1}]},
+            client="human", priority="interactive", timeout=60,
+        )
+        merged = client.wait_result(batch["id"], timeout=60)
+        reply = merged["reply"]
+        summary = reply.get("summary", {})
+        metrics = client.metrics()
+        preemptions = (metrics.get("sched") or {}).get("preemptions", 0)
+        content_identical = reply.get("cells") == ref.get("cells")
+        ok = (
+            inter.get("ok")
+            and reply.get("ok")
+            and content_identical
+            and summary.get("resumed_skipped", 0) >= 1
+            and summary.get("executed", -1)
+            == len(request["cells"]) - summary.get("resumed_skipped", 0)
+            and preemptions >= 1
+        )
+        return {"name": "preempt_resume", "ok": bool(ok),
+                "content_identical": bool(content_identical),
+                "resumed_skipped": summary.get("resumed_skipped"),
+                "executed": summary.get("executed"),
+                "preemptions": preemptions}
+    finally:
+        _finish_server(proc, client)
+
+
 def service_chaos(out_dir: str, full: bool = False) -> dict:
     """The service chaos slice; returns a summary dict (one JSON line via
     ``main``). Reduced (tier-1) runs the in-process-cheap drills; the
     full slice adds the supervised SIGKILL-resume scenario
     (``results/chaos_sweep.json`` carries the committed evidence)."""
-    scenarios = [_scn_poison, _scn_backpressure, _scn_deadline, _scn_drain]
+    scenarios = [_scn_poison, _scn_backpressure, _scn_deadline, _scn_drain,
+                 _scn_tenant_flood, _scn_preempt_resume]
     if full:
         scenarios.append(_scn_sigkill_resume)
     rows = []
@@ -932,6 +1067,42 @@ def child_main(args) -> None:
     }), flush=True)
 
 
+def _main_via_service(args) -> int:
+    """Run the chaos sweep as a tenant of a live simulation service: one
+    ``{"kind": "sweep", "sweep": "chaos"}`` request (batch priority — a
+    sweep driver must never starve interactive work), the summary comes
+    back in the reply. One JSON line either way."""
+    from blades_tpu.service.client import ServiceClient, ServiceError
+
+    n = args.sweep if args.sweep is not None else 24
+    try:
+        client = ServiceClient(args.via_service,
+                               timeout=args.service_timeout)
+        reply = client.submit(
+            {"kind": "sweep", "sweep": "chaos", "spec": {"scenarios": n}},
+            client="chaos", priority="batch",
+            timeout=args.service_timeout,
+        )
+        if not reply.get("ok") or "sweep" not in reply:
+            print(json.dumps({
+                "metric": "chaos_scenarios", "ok": False,
+                "via_service": args.via_service, "reply": reply,
+            }))
+            return 1
+        summary = reply["sweep"]["summary"]
+        summary["via_service"] = args.via_service
+        summary["request_id"] = reply.get("id")
+        print(json.dumps(summary))
+        return 0 if summary.get("ok") else 1
+    except ServiceError as e:
+        print(json.dumps({
+            "metric": "chaos_scenarios", "ok": False,
+            "via_service": args.via_service,
+            "error": f"{type(e).__name__}: {e}"[:500],
+        }))
+        return 1
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--sweep", type=int, default=None, metavar="N",
@@ -946,10 +1117,21 @@ def main() -> int:
     p.add_argument("--service", choices=("reduced", "full"), default=None,
                    help="run the simulation-service chaos slice "
                         "(blades_tpu/service): poison/backpressure/"
-                        "deadline/drain drills, plus supervised "
-                        "SIGKILL-resume under 'full'; alone (no --sweep) "
-                        "prints just the slice's JSON line")
+                        "deadline/drain/tenant-flood/preempt-resume "
+                        "drills, plus supervised SIGKILL-resume under "
+                        "'full'; alone (no --sweep) prints just the "
+                        "slice's JSON line")
+    p.add_argument("--via-service", default=None, metavar="SOCK",
+                   help="submit the chaos sweep as a 'sweep' request to "
+                        "a running simulation service (the chaos driver "
+                        "as a batch tenant) instead of executing "
+                        "in-process")
+    p.add_argument("--service-timeout", type=float, default=3600.0,
+                   help="--via-service: client-side wait bound (s)")
     args = p.parse_args()
+
+    if args.via_service is not None:
+        return _main_via_service(args)
 
     if args.child:
         # supervised children inherit the supervisor's run id via env

@@ -14,6 +14,8 @@ Usage::
 
     python scripts/serve.py submit --socket S --request '{"kind": ...}'
     python scripts/serve.py submit --socket S --request @req.json --no-wait
+    python scripts/serve.py submit --socket S --request @req.json \\
+        --client tenant-a --priority interactive --deadline 30
     python scripts/serve.py result --socket S --id req-... [--wait 120]
     python scripts/serve.py status --socket S
     python scripts/serve.py metrics --socket S
@@ -71,6 +73,7 @@ def _start(args) -> int:
         args.out,
         socket_path=args.socket,
         max_queue=args.max_queue,
+        tenant_quota=args.tenant_quota,
         attempts=args.attempts,
         base_delay_s=args.base_delay,
         cell_deadline_s=args.cell_deadline,
@@ -98,7 +101,11 @@ def _submit(args) -> int:
     request = _load_request(args.request)
     if args.id:
         request["id"] = args.id
-    reply = _client(args).submit(request, wait=not args.no_wait)
+    reply = _client(args).submit(
+        request, wait=not args.no_wait,
+        client=args.client, priority=args.priority,
+        deadline_s=args.deadline,
+    )
     print(json.dumps({"metric": f"{METRIC}_submit", **reply}))
     return 0 if reply.get("ok") else 1
 
@@ -140,6 +147,9 @@ def _run(argv: Optional[list] = None) -> int:
     ps.add_argument("--socket", default=None,
                     help="socket path (default <out>/service.sock)")
     ps.add_argument("--max-queue", type=int, default=8)
+    ps.add_argument("--tenant-quota", type=int, default=None,
+                    help="per-tenant queued-request cap (default: no "
+                         "per-tenant cap, only the global --max-queue)")
     ps.add_argument("--attempts", type=int, default=2,
                     help="per-cell retry budget (resilient ladder)")
     ps.add_argument("--cell-deadline", type=float, default=None,
@@ -166,6 +176,14 @@ def _run(argv: Optional[list] = None) -> int:
                             help="request JSON (or @file)")
             pc.add_argument("--id", default=None)
             pc.add_argument("--no-wait", action="store_true")
+            pc.add_argument("--client", default=None,
+                            help="tenant label (fair-share + quota key)")
+            pc.add_argument("--priority", default=None,
+                            choices=("interactive", "normal", "batch"))
+            pc.add_argument("--deadline", type=float, default=None,
+                            help="deadline (s) for deadline-aware "
+                                 "admission; infeasible => rejected at "
+                                 "submit")
         elif extra == "id":
             pc.add_argument("--id", required=True)
             pc.add_argument("--wait", type=float, default=None,

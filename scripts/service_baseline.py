@@ -33,10 +33,19 @@ of its own committed baseline, warm-request p99 within
 window must emit zero cold-outcome ``program`` records (any compile is
 named with its fingerprint + attributed cause in the gate message).
 
+After the warm ladder, a **two-tenant contention ladder**
+(:func:`measure_contention`, probe-only — it measures scheduling, not
+compilation) runs a hostile flooding tenant against an interactive
+victim over the real socket path and records the victim's warm p99
+under contention, the per-tenant backpressure attribution, and the
+preempt-and-resume merge pins (``contention`` block of the payload;
+gated by ``perf_report.py --check``).
+
 Usage::
 
     python scripts/service_baseline.py [--out results/service]
                                        [--warm-repeats N]
+                                       [--skip-contention]
 
 Reference counterpart: none — the reference pays a cold process per
 configuration (``src/blades/simulator.py``), which is the baseline this
@@ -187,14 +196,166 @@ def measure(aggs=AGGS, rounds: int = 2, warm_repeats: int = WARM_REPEATS) -> dic
     }
 
 
+#: Contention-ladder shape: enough victim requests for a meaningful p99,
+#: flood requests long enough (multi-cell) that preemption is what
+#: bounds the victim's wait, short enough the ladder stays ~tens of
+#: seconds on the 1-core box.
+VICTIM_REQUESTS = 8
+TENANT_QUOTA = 2
+
+
+def measure_contention(
+    victim_requests: int = VICTIM_REQUESTS,
+    tenant_quota: int = TENANT_QUOTA,
+) -> dict:
+    """Two-tenant contention ladder over the REAL socket path: a hostile
+    ``flood`` tenant (batch priority, submits past its quota) vs a
+    ``victim`` tenant (interactive, one request at a time). Measures what
+    the scheduler promises under load:
+
+    - the victim's warm p99 stays bounded (preemption at cell boundaries
+      + strict priority pick — gated by ``perf_report.py --check`` as
+      ``service_victim_warm_p99_s``);
+    - every backpressure reject lands on the flooder (victim rejected
+      == 0, flood rejected >= 1 — pinned);
+    - a preempted-and-resumed batch request's merged reply is
+      content-identical to an uninterrupted run and its final slice
+      executes exactly the unjournaled remainder (pinned).
+
+    Probe-only (jax-free) so the ladder measures scheduling, not
+    compilation."""
+    import tempfile
+    import threading
+
+    from blades_tpu.service.client import ServiceClient
+    from blades_tpu.service.protocol import socket_path_for
+    from blades_tpu.service.server import SimulationService
+
+    base = tempfile.mkdtemp(prefix="service_contention_")
+    svc = SimulationService(
+        base, max_queue=8, tenant_quota=tenant_quota, base_delay_s=0.05,
+    )
+    server = threading.Thread(target=svc.serve, daemon=True,
+                              name="contention-server")
+    server.start()
+    client = ServiceClient(
+        socket_path_for(base), timeout=120,
+        connect_retries=100, connect_delay_s=0.1,
+    )
+    client.ping()
+
+    flood_body = {"kind": "probe", "cells": [
+        {"label": f"f{i}", "op": "sleep", "sleep_s": 0.2, "value": i}
+        for i in range(3)
+    ]}
+    batch_body = {"kind": "probe", "cells": [
+        {"label": f"c{i}", "op": "sleep", "sleep_s": 0.3, "value": i}
+        for i in range(6)
+    ]}
+    try:
+        # -- preempt-and-resume, idle reference first ----------------------
+        ref = client.submit(batch_body, request_id="preempt-ref",
+                            client="batcher", priority="batch",
+                            timeout=120)
+        batch = client.submit(batch_body, request_id="preempt-main",
+                              wait=False, client="batcher",
+                              priority="batch")
+        time.sleep(0.5)  # the worker is mid-sweep when interactive lands
+        client.submit(
+            {"kind": "probe", "cells": [{"label": "i", "op": "ok"}]},
+            client="victim", priority="interactive", timeout=120,
+        )
+        merged = client.wait_result(batch["id"], timeout=120)["reply"]
+        summary = merged.get("summary", {})
+        merged_identical = merged.get("cells") == ref.get("cells")
+
+        # -- flood ladder --------------------------------------------------
+        flood_rejected = 0
+        for i in range(6):  # past the quota: the burst MUST shed
+            r = client.submit(flood_body, wait=False, client="flood",
+                              priority="batch")
+            if r.get("rejected"):
+                flood_rejected += 1
+        for i in range(max(0, int(victim_requests))):
+            # keep the flooder's backlog saturated through the ladder
+            r = client.submit(flood_body, wait=False, client="flood",
+                              priority="batch")
+            if r.get("rejected"):
+                flood_rejected += 1
+            client.submit(
+                {"kind": "probe",
+                 "cells": [{"label": f"v{i}", "op": "ok", "value": i}]},
+                client="victim", priority="interactive", timeout=120,
+            )
+        metrics = client.metrics()
+        client.drain()
+    except BaseException:
+        try:
+            client.drain()
+        except Exception:  # noqa: BLE001 - already failing; reap the thread
+            pass
+        server.join(timeout=60)
+        raise
+    server.join(timeout=120)
+
+    by_client = metrics.get("by_client") or {}
+    victim_m = by_client.get("victim") or {}
+    flood_m = by_client.get("flood") or {}
+    victim_warm = victim_m.get("warm_latency") or {}
+    sched = metrics.get("sched") or {}
+    preemptions = sched.get("preemptions", 0)
+    cells = len(batch_body["cells"])
+    resumed_skipped = summary.get("resumed_skipped", 0)
+    executed_after_resume = summary.get("executed")
+    return {
+        "tenant_quota": tenant_quota,
+        "victim": {
+            "p99_s": victim_warm.get("p99_s"),
+            "warm_latency": victim_warm,
+            "requests": victim_m.get("served", 0),
+            "rejected": victim_m.get("rejected", 0),
+        },
+        "flood": {
+            "rejected": flood_m.get("rejected", 0),
+            "rejected_replies": flood_rejected,
+            "quota": tenant_quota,
+        },
+        "preempt": {
+            "cells": cells,
+            "resumed_skipped": resumed_skipped,
+            "executed_after_resume": executed_after_resume,
+            "merged_identical": bool(merged_identical),
+            "preemptions": preemptions,
+        },
+        "queue_depth_by_class_hwm": sched.get("queue_depth_by_class_hwm"),
+        "ok": bool(
+            merged_identical
+            and preemptions >= 1
+            and resumed_skipped >= 1
+            and executed_after_resume == cells - resumed_skipped
+            and victim_m.get("rejected", 0) == 0
+            and flood_m.get("rejected", 0) >= 1
+            and flood_m.get("rejected", 0) == flood_rejected
+            and victim_warm.get("p99_s") is not None
+        ),
+    }
+
+
 def _run(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--out", default=os.path.join(REPO, "results", "service"))
     p.add_argument("--rounds", type=int, default=2)
     p.add_argument("--warm-repeats", type=int, default=WARM_REPEATS,
                    help="extra identical warm requests for the p99 ladder")
+    p.add_argument("--skip-contention", action="store_true",
+                   help="skip the two-tenant contention ladder")
     args = p.parse_args(argv)
     payload = measure(rounds=args.rounds, warm_repeats=args.warm_repeats)
+    if not args.skip_contention:
+        # the two-tenant scheduler evidence rides the same committed
+        # artifact: one file, one perf_report evidence source
+        payload["contention"] = measure_contention()
+        payload["ok"] = bool(payload["ok"] and payload["contention"]["ok"])
     os.makedirs(args.out, exist_ok=True)
     with open(os.path.join(args.out, "warm_serving.json"), "w") as f:
         json.dump(payload, f, indent=1, sort_keys=True)

@@ -253,7 +253,8 @@ def summarize_service(
     # the wedged-vs-idle signal this block exists for
     snap = next((r for r in reversed(svc) if "served" in r), None)
     if snap is not None:
-        for field in ("queue_depth", "in_flight", "in_flight_id",
+        for field in ("queue_depth", "queue_by_class", "tenants",
+                      "preemptions", "in_flight", "in_flight_id",
                       "in_flight_age_s", "served", "rejected",
                       "quarantined_requests", "oldest_pending_age_s",
                       "draining", "uptime_s"):
@@ -289,6 +290,17 @@ def summarize_service(
         hwm = (m.get("queue") or {}).get("depth_hwm")
         if hwm is not None:
             out["queue_depth_hwm"] = hwm
+        # scheduler rollup (PR 17): preemption count + deadline-admission
+        # verdicts + per-class depth high-water marks — a contended
+        # multi-tenant server is legible from its trace alone
+        sched = m.get("sched") or {}
+        if sched:
+            out["sched"] = {
+                k: sched[k]
+                for k in ("preemptions", "admission",
+                          "queue_depth_by_class_hwm")
+                if k in sched and sched[k]
+            }
     last_ts = max(
         (r["ts"] for r in svc + reqs + snaps
          if isinstance(r.get("ts"), (int, float))),

@@ -737,6 +737,23 @@ def test_import_reqpath_before_jax():
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+def test_import_scheduler_before_jax():
+    """PR 17 contract: the multi-tenant scheduler sits on the listener's
+    admission path (overflow verdicts, deadline estimates) — a scheduling
+    decision must never be the import that drags jax into a probe-only
+    server."""
+    proc = _import_probe(
+        "from blades_tpu.service.scheduler import ("
+        "TenantScheduler, CostEstimator, ScheduledRequest); "
+        "s = TenantScheduler(max_queue=2, tenant_quota=1); "
+        "s.put(ScheduledRequest(request_id='r', request={})); "
+        "assert s.overflow('anon')['scope'] == 'tenant'; "
+        "assert CostEstimator(lambda: None, lambda: None)"
+        ".verdict(5, 0.001) == ('no_estimate', None)"
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
 def test_import_analysis_tier_a_before_jax():
     """Tier A must lint (not just import) without jax — it is the gate
     that still works when the accelerator tunnel is down."""

@@ -196,11 +196,15 @@ def test_service_chaos_reduced(tmp_path):
     request quarantined (attributable error) while siblings and a
     concurrent request complete; backpressure rejects with an explicit
     reply; a hung cell trips the deadline without wedging the server;
-    drain exits 0 with zero lost requests."""
+    drain exits 0 with zero lost requests; a flooding tenant is contained
+    by its quota (every reject tenant-attributed, the victim untouched);
+    a preempted batch request resumes to a reply content-identical to an
+    uninterrupted run."""
     summary = chaos.service_chaos(str(tmp_path), full=False)
     assert summary["ok"], json.dumps(summary, indent=1)
     assert [s["name"] for s in summary["scenarios"]] == [
         "poison_isolated", "backpressure", "deadline_hang", "drain_no_loss",
+        "tenant_flood", "preempt_resume",
     ]
 
 
@@ -428,6 +432,166 @@ def test_unsafe_client_label_rejected(tmp_path):
     finally:
         rc, _, _ = _finish(proc, client)
     assert rc == 0
+
+
+# -- multi-tenant scheduling & deadline-aware admission (PR 17) ----------------
+
+
+def test_admission_cold_start_admits_then_infeasible_rejected(tmp_path):
+    """The admission estimator's failure modes, e2e: a fresh server has
+    NO history, so a deadline-carrying request is admitted under the
+    `no_estimate` verdict (cold start must admit — the estimator is
+    advisory); once history exists, an unmeetable deadline is rejected
+    `deadline_infeasible` BEFORE spooling (the id never enters the spool
+    and can be reused), and a feasible one admits as `estimated`."""
+    out, proc, client = _start(tmp_path, "svc")
+    try:
+        req = {"kind": "probe", "cells": [{"label": "a", "op": "ok",
+                                           "value": 1}]}
+        # cold start: no completed cells -> no estimate -> admitted
+        first = client.submit(req, deadline_s=1e-9)
+        assert first["ok"], first
+
+        # with history, an impossible deadline is rejected pre-spool
+        rid = mint_request_id()
+        rej = client.submit(req, request_id=rid, deadline_s=1e-9)
+        assert rej["ok"] is False
+        assert rej["rejected"] == "deadline_infeasible"
+        est = rej["est"]
+        assert est["eta_s"] > est["deadline_s"] == 1e-9
+        assert est["cells"] == 1 and est["est_s"] >= 0.0
+        spooled = open(os.path.join(out, "spool.jsonl")).read()
+        assert rid not in spooled  # rejected before admission, not after
+        assert client.status()["served"] == 1
+
+        # the same id resubmitted with a sane deadline executes normally
+        # (nothing about the rejection was persisted)
+        ok = client.submit(req, request_id=rid, deadline_s=60.0)
+        assert ok["ok"] and ok["cells"][0]["result"]["value"] == 1
+
+        m = client.metrics()
+        assert m["sched"]["admission"] == {
+            "no_estimate": 1, "infeasible": 1, "estimated": 1,
+        }
+        assert m["rejected_by_reason"]["deadline_infeasible"] == 1
+    finally:
+        rc, _, _ = _finish(proc, client)
+    assert rc == 0
+
+
+def test_wrong_estimate_bounded_by_cell_deadline_ladder(tmp_path):
+    """A WRONG admission estimate (history says cells are instant, the
+    request actually hangs) must not wedge the server: the estimator
+    admits, and the PR 13 per-cell deadline ladder — the hard layer —
+    quarantines the hung cell with an attributable error."""
+    out, proc, client = _start(
+        tmp_path, "svc", "--cell-deadline", "0.3", "--attempts", "1",
+    )
+    try:
+        # history: one instant cell -> warm_cell_s is microseconds
+        client.submit({"kind": "probe",
+                       "cells": [{"label": "fast", "op": "ok"}]})
+        # estimator predicts ~0s, so a 20s deadline admits `estimated` —
+        # but the cell sleeps 60s: the estimate is wrong by 5 orders
+        reply = client.submit(
+            {"kind": "probe",
+             "cells": [{"label": "hang", "op": "sleep", "sleep_s": 60}]},
+            deadline_s=20.0, timeout=60,
+        )
+        assert reply["status"] == "done"
+        cell = reply["cells"][0]
+        assert cell["quarantined"]
+        assert cell["error_type"] == "DeadlineExceeded"
+        m = client.metrics()
+        assert m["sched"]["admission"].get("estimated") == 1
+        # the server is still serving after the bad estimate
+        assert client.submit({"kind": "probe", "cells": [
+            {"label": "alive", "op": "ok"}]})["ok"]
+    finally:
+        rc, _, _ = _finish(proc, client)
+    assert rc == 0
+
+
+def test_status_reports_tenant_composition_and_queue_by_class(tmp_path):
+    """`op: status` surfaces the per-tenant queue composition and the
+    per-class depths while requests are queued: a starved tenant (and a
+    backed-up class) is attributable from the health surface alone."""
+    import time as _time
+
+    out, proc, client = _start(tmp_path, "svc")
+    try:
+        busy = client.submit(
+            {"kind": "probe", "client": "miner", "priority": "batch",
+             "cells": [{"label": "s", "op": "sleep", "sleep_s": 2.0}]},
+            wait=False,
+        )
+        _time.sleep(0.4)  # let the worker pick the sleeper up
+        q1 = client.submit(
+            {"kind": "probe", "client": "alice", "priority": "interactive",
+             "cells": [{"label": "a", "op": "ok"}]},
+            wait=False,
+        )
+        q2 = client.submit(
+            {"kind": "probe", "client": "bob",
+             "cells": [{"label": "b", "op": "ok"}]},
+            wait=False,
+        )
+        status = client.status()
+        assert status["queue_by_class"]["interactive"] == 1
+        assert status["queue_by_class"]["normal"] == 1
+        assert status["queue_by_class"]["batch"] == 0
+        tenants = status["tenants"]
+        assert tenants["alice"]["depth"] == 1
+        assert tenants["alice"]["priority"] == "interactive"
+        assert tenants["bob"]["depth"] == 1
+        assert tenants["alice"]["oldest_age_s"] >= 0.0
+        assert status["preemptions"] == 0  # single-cell sleeper: no yield
+        for r in (busy, q1, q2):
+            assert client.wait_result(r["id"], timeout=30)["ok"]
+        idle = client.status()
+        assert idle["tenants"] == {} or "tenants" not in idle
+    finally:
+        rc, _, _ = _finish(proc, client)
+    assert rc == 0
+
+
+def test_summarize_service_sched_and_tenant_fields():
+    """The sweep_status service block surfaces the scheduler rollup from
+    the latest metrics_snapshot (preemptions, admission verdicts,
+    per-class depth HWM) and the per-tenant composition from the newest
+    health record."""
+    import sweep_status
+
+    records = [
+        {"t": "service", "event": "health", "ts": 100.0, "served": 3,
+         "queue_depth": 2, "queue_by_class": {"interactive": 1,
+                                              "normal": 1, "batch": 0},
+         "tenants": {"flood": {"depth": 2, "oldest_age_s": 1.5,
+                               "priority": "normal"}},
+         "preemptions": 2},
+        {"t": "metrics_snapshot", "ts": 101.0, "uptime_s": 52.0,
+         "requests": {"warm": 4}, "queue": {"depth_hwm": 6},
+         "latency": {"warm": {"count": 4, "p99_s": 0.5}},
+         "split": {"queue_wait_share": 0.4},
+         "sched": {"preemptions": 2,
+                   "admission": {"estimated": 3, "infeasible": 1},
+                   "queue_depth_by_class_hwm": {"interactive": 1,
+                                                "normal": 2, "batch": 0}}},
+    ]
+    out = sweep_status.summarize_service(records, now=120.0)
+    assert out["queue_by_class"] == {"interactive": 1, "normal": 1,
+                                     "batch": 0}
+    assert out["tenants"]["flood"]["depth"] == 2
+    assert out["preemptions"] == 2
+    assert out["sched"]["preemptions"] == 2
+    assert out["sched"]["admission"] == {"estimated": 3, "infeasible": 1}
+    assert out["sched"]["queue_depth_by_class_hwm"]["normal"] == 2
+    # a pre-scheduler trace (no sched block) keeps the old shape
+    legacy = sweep_status.summarize_service(
+        [{"t": "service", "event": "health", "ts": 100.0, "served": 1}],
+        now=120.0,
+    )
+    assert "sched" not in legacy and "tenants" not in legacy
 
 
 def test_summarize_service_metrics_snapshot_fields():
@@ -703,6 +867,72 @@ def test_check_warm_serving_p99_and_queue_wait_directions():
     old_baseline = {"derived": {"service_warm_cell_s": 0.06}, "rows": {}}
     assert perf_report.check_warm_serving(
         regressed, old_baseline, thresholds) == []
+
+
+def test_check_contention_gate_directions():
+    """The tenant-isolation gates (PR 17), both directions: a healthy
+    contention ladder (victim p99 within victim_p99_frac, zero victim
+    rejects, >= 1 flood reject, preempt-resume merged identical with
+    exactly the remainder executed) passes; each regressed pin fires its
+    own message; the gates stay dormant until the baseline records the
+    victim's contended p99."""
+    import perf_report
+
+    thresholds = dict(perf_report.DEFAULT_THRESHOLDS)
+    baseline = {
+        "derived": {
+            "service_warm_cell_s": 0.06,
+            "service_victim_warm_p99_s": 0.5,
+        },
+        "rows": {},
+    }
+    good_cont = {
+        "victim": {"p99_s": 0.5, "rejected": 0},
+        "flood": {"rejected": 3},
+        "preempt": {"merged_identical": True, "preemptions": 1,
+                    "cells": 6, "resumed_skipped": 2,
+                    "executed_after_resume": 4},
+    }
+    good = {"warm_compiles": 0, "warm_mean_cell_s": 0.06,
+            "contention": good_cont}
+    assert perf_report.check_warm_serving(good, baseline, thresholds) == []
+    # exactly at the threshold: the gate fires on >
+    edge = dict(good, contention=dict(
+        good_cont, victim={"p99_s": 0.5 * thresholds["victim_p99_frac"],
+                           "rejected": 0}))
+    assert perf_report.check_warm_serving(edge, baseline, thresholds) == []
+
+    # every pin regressed at once: each fires its own message
+    bad = dict(good, contention={
+        "victim": {"p99_s": 50.0, "rejected": 2},
+        "flood": {"rejected": 0},
+        "preempt": {"merged_identical": False, "preemptions": 0,
+                    "cells": 6, "resumed_skipped": 2,
+                    "executed_after_resume": 6},
+    })
+    msgs = perf_report.check_warm_serving(bad, baseline, thresholds)
+    assert len(msgs) == 6
+    assert any("victim-tenant warm p99 under contention" in m for m in msgs)
+    assert any("victim tenant absorbed 2 backpressure" in m for m in msgs)
+    assert any("flooding tenant absorbed 0" in m for m in msgs)
+    assert any("NOT content-identical" in m for m in msgs)
+    assert any("0 preemptions" in m for m in msgs)
+    assert any("executed 6 cells != remainder 6 - 2" in m for m in msgs)
+
+    # evidence regenerated without the contention ladder: the armed gate
+    # reports the hole instead of silently passing
+    stale = {"warm_compiles": 0, "warm_mean_cell_s": 0.06}
+    msgs = perf_report.check_warm_serving(stale, baseline, thresholds)
+    assert any("contention evidence missing" in m for m in msgs)
+    hollow = dict(good, contention={"preempt": good_cont["preempt"],
+                                    "flood": {"rejected": 3}})
+    msgs = perf_report.check_warm_serving(hollow, baseline, thresholds)
+    assert any("victim-tenant warm p99 missing" in m for m in msgs)
+
+    # dormant before the baseline records the contended p99
+    old_baseline = {"derived": {"service_warm_cell_s": 0.06}, "rows": {}}
+    assert perf_report.check_warm_serving(bad, old_baseline,
+                                          thresholds) == []
 
 
 def test_committed_warm_serving_evidence_passes_gate():
