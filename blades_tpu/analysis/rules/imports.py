@@ -66,6 +66,12 @@ NO_JAX_SUFFIXES = (
     # path (overflow verdicts, deadline estimates) — it must work with
     # the tunnel down, jax-free, like the rest of the service layer
     "blades_tpu/service/scheduler.py",
+    # the worker pool (PR 19): the parent's dispatch/kill loop must run
+    # jax-free (the whole point is that ONLY workers pay jax init), and
+    # a worker process must reach its `ready` frame in interpreter-import
+    # time — jax lands lazily on its first simulate cell
+    "blades_tpu/service/workers.py",
+    "blades_tpu/service/worker.py",
 )
 
 #: blades modules known to import jax at module scope — importing one of

@@ -6,10 +6,16 @@ executor consumes (:func:`blades_tpu.sweeps.resilient
 .run_cells_resilient`). Two built-in kinds:
 
 - ``probe`` — stdlib-only cells for health checks and chaos drills: each
-  cell is ``{"label", "op": "ok" | "fail" | "sleep", ...}``. ``ok``
-  echoes a deterministic result, ``fail`` raises (the poison-request
-  drill), ``sleep`` blocks for ``sleep_s`` (the hung-request drill — it
-  trips the per-cell deadline). Probe requests never import jax, so a
+  cell is ``{"label", "op": "ok" | "fail" | "sleep" | "abort", ...}``.
+  ``ok`` echoes a deterministic result, ``fail`` raises (the
+  poison-request drill), ``sleep`` blocks for ``sleep_s`` (the
+  hung-request drill — it trips the per-cell deadline), ``abort``
+  SIGABRTs the executing process mid-cell (the worker-crash drill —
+  only meaningful under the worker pool). ``sleep``/``abort`` take an
+  optional ``once`` sentinel path: the first execution arms it and
+  misbehaves, every later attempt behaves — so a retry or a
+  replacement worker completes and the merged reply stays
+  content-identical. Probe requests never import jax, so a
   probe-only server starts in interpreter-import time and the chaos
   service scenarios (``scripts/chaos.py --service``) run in seconds.
 - ``simulate`` — each cell is a chaos-style scenario dict (``agg``,
@@ -350,15 +356,34 @@ def _chaos_plan(spec: Dict[str, Any], ctx: Dict[str, Any]) -> RequestPlan:
 
 def _run_probe(payload: Dict[str, Any]) -> Dict[str, Any]:
     op = payload.get("op", "ok")
+    # ``once``: a sentinel path that arms the saboteur exactly once —
+    # the first execution creates it and misbehaves; every later attempt
+    # (a retry, a replacement worker's resume) finds it and behaves.
+    # The result row NEVER includes once/sleep_s, so a disturbed run's
+    # merged reply stays content-identical to an undisturbed one.
+    once = payload.get("once")
+    armed = bool(once) and not os.path.exists(str(once))
+    if armed:
+        with open(str(once), "w") as fh:
+            fh.write(str(os.getpid()))
     if op == "fail":
         raise RuntimeError(
             str(payload.get("message") or "probe cell requested failure")
         )
-    if op == "sleep":
-        # the hung-request drill: blocks until the per-cell soft deadline
-        # (SIGALRM interrupts the sleep) or completion
-        time.sleep(float(payload.get("sleep_s", 1.0)))
-    elif op != "ok":
+    if op == "abort":
+        # the worker-crash drill: SIGABRT the whole process mid-cell —
+        # only meaningful under the worker pool (in-process it would
+        # kill the server, which is exactly what the pool prevents)
+        if once is None or armed:
+            os.abort()
+    elif op == "sleep":
+        # the hung-request drill: blocks until the per-cell soft
+        # deadline (SIGALRM in-process; the parent's group-kill under
+        # the pool) or completion. With ``once``, only the FIRST
+        # attempt hangs — the retry/replacement completes instantly.
+        if once is None or armed:
+            time.sleep(float(payload.get("sleep_s", 1.0)))
+    elif op not in ("ok", "fail"):
         raise ValueError(f"unknown probe op {op!r}")
     return {
         "label": str(payload["label"]),

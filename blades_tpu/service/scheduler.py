@@ -146,10 +146,15 @@ class TenantScheduler:
         #: virtual time per tenant: served seconds / weight. The
         #: laggiest tenant schedules next within a class.
         self._vtime: Dict[str, float] = {}
-        #: affinity fingerprints whose programs are warm (an identical
-        #: static config already executed in this process).
-        self._warm: Set[str] = set()
-        self._in_flight: Optional[ScheduledRequest] = None
+        #: affinity fingerprint -> the worker ids (or the ``"inproc"``
+        #: sentinel for the workers=0 path) whose PROCESS has executed
+        #: that static config. Warmth is per-process: each worker owns
+        #: its own ``EngineCache``, so a fingerprint warm on w0 is still
+        #: cold on w1 — and a replaced worker's warmth dies with it.
+        self._warm: Dict[str, Set[str]] = {}
+        #: in-flight requests by id — one entry on the in-process path,
+        #: up to W under the worker pool.
+        self._in_flight: Dict[str, ScheduledRequest] = {}
 
     # -- admission -------------------------------------------------------------
 
@@ -215,20 +220,36 @@ class TenantScheduler:
             self._entries.append(entry)
             self._cond.notify()
 
-    def requeue(self, entry: ScheduledRequest) -> None:
-        """Put a preempted request back. It keeps its original ``seq``
-        (head of its tenant's line, not the tail) and admission stamp;
-        only the preemption count advances."""
+    def requeue(self, entry: ScheduledRequest, preempted: bool = True) -> None:
+        """Put a preempted (or worker-orphaned) request back. It keeps
+        its original ``seq`` (head of its tenant's line, not the tail)
+        and admission stamp; the preemption count advances only for a
+        true preemption — a request requeued because its WORKER died was
+        not preempted, it was orphaned."""
         with self._cond:
-            entry.preemptions += 1
-            if self._in_flight is entry:
-                self._in_flight = None
+            if preempted:
+                entry.preemptions += 1
+            self._in_flight.pop(entry.request_id, None)
             self._entries.append(entry)
             self._cond.notify()
 
     # -- scheduling ------------------------------------------------------------
 
-    def _select_locked(self) -> Optional[ScheduledRequest]:
+    def _warm_here(self, entry: ScheduledRequest, worker: Optional[str]) -> bool:
+        """Is ``entry``'s affinity warm on the process that would run it?
+        ``worker=None`` is the in-process path (``"inproc"`` sentinel)."""
+        if not entry.affinity:
+            return False
+        procs = self._warm.get(entry.affinity)
+        if not procs:
+            return False
+        return (worker if worker is not None else "inproc") in procs
+
+    def _select_locked(
+        self,
+        worker: Optional[str] = None,
+        warm_only: bool = False,
+    ) -> Optional[ScheduledRequest]:
         if not self._entries:
             return None
         best_rank = min(e.rank for e in self._entries)
@@ -244,26 +265,45 @@ class TenantScheduler:
             ),
         )
         # warm-first within the tenant: a request whose affinity is
-        # already warm runs before one that would compile cold, so cold
-        # builds batch at the line's tail instead of interleaving
-        return min(
+        # already warm ON THIS PROCESS runs before one that would
+        # compile cold, so cold builds batch at the line's tail instead
+        # of interleaving with warm traffic. Under the pool, warmth is
+        # per-worker — the fingerprint pin survives because repeats
+        # route back to the process holding the compiled programs.
+        chosen = min(
             by_tenant[tenant],
             key=lambda e: (
-                0 if (e.affinity and e.affinity in self._warm) else 1,
+                0 if self._warm_here(e, worker) else 1,
                 e.seq,
             ),
         )
+        if warm_only and not self._warm_here(chosen, worker):
+            # warm-affinity pass: only hand this worker a request it is
+            # already warm for. Filtering AFTER priority/fair selection
+            # keeps strict class order and tenant fairness intact — a
+            # warm request never jumps a colder-but-laggier tenant.
+            return None
+        return chosen
 
-    def pick(self, timeout: float) -> Optional[ScheduledRequest]:
+    def pick(
+        self,
+        timeout: float,
+        worker: Optional[str] = None,
+        warm_only: bool = False,
+    ) -> Optional[ScheduledRequest]:
         """Dequeue the next runnable request, blocking up to ``timeout``
-        seconds; ``None`` on timeout (the worker's idle tick)."""
+        seconds; ``None`` on timeout (the worker's idle tick). ``worker``
+        names the worker process the pick is for (warm-first routing);
+        ``warm_only`` turns the pick into the dispatch loop's
+        affinity pass — return a request only if this worker is warm
+        for it."""
         deadline = time.monotonic() + max(0.0, float(timeout))
         with self._cond:
             while True:
-                entry = self._select_locked()
+                entry = self._select_locked(worker, warm_only)
                 if entry is not None:
                     self._entries.remove(entry)
-                    self._in_flight = entry
+                    self._in_flight[entry.request_id] = entry
                     return entry
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -281,10 +321,9 @@ class TenantScheduler:
             )
 
     def done(self, entry: ScheduledRequest) -> None:
-        """The in-flight request finished (reply spooled)."""
+        """An in-flight request finished (reply spooled)."""
         with self._cond:
-            if self._in_flight is entry:
-                self._in_flight = None
+            self._in_flight.pop(entry.request_id, None)
 
     def waiting_above(self, priority: str) -> bool:
         """Is a strictly higher-priority request queued? The
@@ -296,16 +335,44 @@ class TenantScheduler:
 
     # -- warm affinity ---------------------------------------------------------
 
-    def note_warm(self, affinity: Optional[str]) -> None:
+    def note_warm(
+        self, affinity: Optional[str], worker: Optional[str] = None
+    ) -> None:
+        """Record that ``affinity``'s programs are now warm on
+        ``worker``'s process (``None`` = the in-process path)."""
         if affinity:
             with self._cond:
-                self._warm.add(affinity)
+                self._warm.setdefault(affinity, set()).add(
+                    worker if worker is not None else "inproc"
+                )
 
-    def is_warm(self, affinity: Optional[str]) -> bool:
+    def forget_worker(self, worker: str) -> int:
+        """Drop every warmth claim for a dead worker's process (its
+        ``EngineCache`` died with it); returns how many fingerprints
+        went cold for it."""
+        dropped = 0
+        with self._cond:
+            for affinity in list(self._warm):
+                procs = self._warm[affinity]
+                if worker in procs:
+                    procs.discard(worker)
+                    dropped += 1
+                    if not procs:
+                        del self._warm[affinity]
+        return dropped
+
+    def is_warm(
+        self, affinity: Optional[str], worker: Optional[str] = None
+    ) -> bool:
+        """Is ``affinity`` warm anywhere (``worker=None``: any process —
+        the admission estimator's question) or on one specific worker?"""
         if not affinity:
             return False
         with self._cond:
-            return affinity in self._warm
+            procs = self._warm.get(affinity)
+            if not procs:
+                return False
+            return True if worker is None else worker in procs
 
     # -- introspection ---------------------------------------------------------
 
@@ -358,8 +425,9 @@ class TenantScheduler:
             total = sum(
                 e.est_s or 0.0 for e in self._entries if e.rank <= rank
             )
-            if self._in_flight is not None:
-                total += self._in_flight.est_s or 0.0
+            total += sum(
+                e.est_s or 0.0 for e in self._in_flight.values()
+            )
         return total
 
 

@@ -77,7 +77,7 @@ import signal
 import socket
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from blades_tpu.service import protocol as _protocol
 from blades_tpu.service import scheduler as _scheduler
@@ -99,6 +99,13 @@ TRACE_NAME = "service_trace.jsonl"
 
 #: Spool filename inside the --out directory.
 SPOOL_NAME = "spool.jsonl"
+
+#: Grace the parent's deadline enforcement adds on top of the armed
+#: per-cell budget before killing a worker: the in-process alarm fires
+#: exactly at the deadline, but the parent only observes at poll
+#: cadence and must not kill a worker that would have finished inside
+#: the budget it was promised.
+DEADLINE_SLACK_S = 1.0
 
 
 class _LockedRecorder(Recorder):
@@ -218,6 +225,17 @@ class SimulationService:
     resume : replay the spool's pending requests before accepting new
         ones; default reads ``BLADES_RESUME`` (the supervisor's relaunch
         contract).
+    workers : worker-process pool size. ``0`` (default) keeps the PR 17
+        in-process path bit-identically (SIGALRM deadlines, one request
+        at a time). ``N > 0`` spawns N worker processes (``service/
+        workers.py``): requests execute in children, per-cell deadlines
+        are parent-enforced by group-kill (no SIGALRM anywhere), a
+        crashed/hung worker is replaced and its request's journaled
+        cells salvaged — the reply stays content-identical to an
+        undisturbed run. On this 1-core box W=1 isolates without adding
+        throughput; W=2 buys concurrency during a request's I/O and
+        build phases at contention cost (docs/robustness.md "Worker
+        isolation").
     """
 
     def __init__(
@@ -232,6 +250,7 @@ class SimulationService:
         health_interval_s: float = 30.0,
         poll_s: float = 0.5,
         resume: Optional[bool] = None,
+        workers: int = 0,
     ):
         self.out_dir = out_dir
         os.makedirs(out_dir, exist_ok=True)
@@ -243,6 +262,15 @@ class SimulationService:
         self.cell_deadline_s = cell_deadline_s
         self.health_interval_s = float(health_interval_s)
         self.poll_s = float(poll_s)
+        self.workers = int(workers)
+        #: the worker pool (service/workers.py), built in serve() when
+        #: workers > 0; None on the in-process path
+        self._pool = None
+        #: parent-side kill ladder: (request_id, cell_label) -> kills so
+        #: far. At `attempts` kills the parent quarantines the cell in
+        #: the request's journal itself — a cell that deterministically
+        #: hangs/crashes its worker must not respawn workers forever.
+        self._kills: Dict[Tuple[str, str], int] = {}
         if resume is None:
             resume = os.environ.get(_heartbeat.RESUME_ENV) == "1"
         self.resume = bool(resume)
@@ -337,6 +365,27 @@ class SimulationService:
             in_flight_since = self._in_flight_since
         now = time.time()
         oldest = min(pending.values(), default=None)
+        pool = self._pool
+        pool_block: Dict[str, Any] = {}
+        if pool is not None:
+            wsnap = pool.snapshot()
+            pool_block["workers"] = wsnap
+            # under the pool, "in flight" is the busy-worker set; the
+            # attributable id/age come from the oldest assignment
+            busy = [
+                h for h in list(pool.workers.values())
+                if h.state == "busy" and h.entry is not None
+                and h.assigned_ts is not None
+            ]
+            if busy:
+                oldest_busy = min(busy, key=lambda h: h.assigned_ts)
+                in_flight = getattr(
+                    oldest_busy.entry, "request_id", None
+                )
+                in_flight_since = oldest_busy.assigned_ts
+            in_flight_count = wsnap["busy"]
+        else:
+            in_flight_count = 1 if in_flight else 0
         return {
             "queue_depth": self._sched.qsize(),
             # per-class depths + per-tenant composition: a starved (or
@@ -346,7 +395,7 @@ class SimulationService:
             "queue_by_class": self._sched.depth_by_class(),
             "tenants": self._sched.composition(),
             "preemptions": self.preemptions,
-            "in_flight": 1 if in_flight else 0,
+            "in_flight": in_flight_count,
             # the in-flight request's identity and age, not a bare 0/1:
             # a wedged request must be attributable from this surface
             **(
@@ -355,6 +404,7 @@ class SimulationService:
                 if in_flight and in_flight_since is not None
                 else {}
             ),
+            **pool_block,
             "served": self.served,
             "rejected": self.rejected,
             "quarantined_requests": self.quarantined_requests,
@@ -394,6 +444,10 @@ class SimulationService:
                 for k in ("in_flight_id", "in_flight_age_s")
                 if k in snap
             },
+            # the per-worker health block rides every service record
+            # once the pool exists: a hung worker (cell age growing) or
+            # a restart storm is attributable from the trace alone
+            **({"workers": snap["workers"]} if "workers" in snap else {}),
         )
         # the rolling serving metrics ride the same cadence: one
         # schema-locked snapshot record per health beat, so queue-wait
@@ -473,6 +527,8 @@ class SimulationService:
                 # provenance): live over the socket, same dict the
                 # `cache_stats` trace records flush each health beat
                 reply["engine_cache"] = self._engine_cache.stats()
+            if self._pool is not None:
+                reply["workers"] = self._pool.snapshot()
             self._reply_and_close(f, conn, reply)
         elif op == "result":
             rid = str(msg.get("id") or "")
@@ -946,6 +1002,488 @@ class SimulationService:
             self._health()
         return self._snapshot()
 
+    # -- worker pool -----------------------------------------------------------
+    #
+    # The pooled counterpart of _work(): requests execute in worker
+    # PROCESSES (service/worker.py), the parent keeps every piece of
+    # server bookkeeping (lifecycle paths, ledger, spool, waiter
+    # replies, the single service trace) and — instead of SIGALRM —
+    # enforces per-cell deadlines by group-killing an over-budget
+    # worker. A killed/crashed worker's request is requeued; the
+    # replacement recovers its journaled cells and executes only the
+    # remainder (the PR 13 resume invariant, exercised by worker death).
+
+    def _work_pool(self) -> Dict[str, Any]:
+        pool = self._pool
+        assert pool is not None
+        try:
+            while True:
+                self._dispatch(pool)
+                events = pool.poll(self.poll_s)
+                for wid, ev in events:
+                    self._on_worker_event(pool, wid, ev)
+                self._enforce_deadlines(pool)
+                self._maybe_yield(pool)
+                # the parent beats EVERY tick: a hung worker stalls one
+                # request, never the server's own supervision heartbeat
+                self._beat_idle()
+                if (
+                    self._draining.is_set()
+                    and self._sched.empty()
+                    and not pool.busy()
+                ):
+                    # same race-free drain exit as _work(): stop the
+                    # listener FIRST, then re-check — anything it
+                    # admitted in the gap is in the queue now
+                    self._shutdown_listener()
+                    if self._sched.empty() and not pool.busy():
+                        break
+        finally:
+            info = pool.shutdown()
+            self.event(
+                "worker", event="pool_shutdown",
+                restarts=info["restarts"], kills=info["kills"],
+                survivors=info["survivors"],
+            )
+        return self._snapshot()
+
+    def _dispatch(self, pool) -> None:
+        """Fill idle workers. Two passes: first each idle worker takes a
+        request it is already WARM for (per-worker affinity — the
+        zero-compile warm pin survives the pool because repeats route
+        back to the process holding the compiled programs), then any
+        remaining idle worker takes the scheduler's plain next pick."""
+        for handle in pool.idle():
+            entry = self._sched.pick(0, worker=handle.wid, warm_only=True)
+            if entry is not None:
+                self._assign(pool, handle, entry)
+        for handle in pool.idle():
+            entry = self._sched.pick(0, worker=handle.wid)
+            if entry is None:
+                break
+            self._assign(pool, handle, entry)
+
+    def _assign(self, pool, handle, entry) -> None:
+        rid = entry.request_id
+        request = entry.request
+        with self._state_lock:
+            admit_ts = self._pending_ts.get(rid)
+        queue_age = time.time() - admit_ts if admit_ts else None
+        path = self.metrics.get(rid)
+        if path is None:
+            path = self.metrics.admit(
+                rid, op=str(request.get("kind")),
+                client=str(request.get("client") or "anon"),
+            )
+        # zero-baseline counters: the parent never compiles, so the
+        # worker-reported counter delta at finish is the whole request's
+        # build work — warm/cold classification stays honest in-pool
+        path.start(counters={})
+        handle.entry = entry
+        handle.assigned_ts = time.time()
+        handle.state = "busy"
+        handle.ledger = _ledger.run_started(
+            "request",
+            config={
+                "id": rid,
+                "kind": request.get("kind"),
+                "cells": len(request.get("cells") or []),
+            },
+        )
+        self.event(
+            "request", event="started", id=rid,
+            kind=str(request.get("kind")), cells=estimate_cells(request),
+            worker=handle.wid,
+            **({"queue_age_s": round(queue_age, 3)}
+               if queue_age is not None else {}),
+        )
+        self.event("worker", event="assign", worker=handle.wid,
+                   request=rid)
+        sent = pool.send(handle.wid, {
+            "op": "assign", "id": rid, "request": request,
+            "options": {
+                "attempts": self.attempts,
+                "base_delay_s": self.base_delay_s,
+                "cell_deadline_s": self.cell_deadline_s,
+            },
+        })
+        if not sent:
+            # dead pipe: the reader's _eof frame reaps and salvages on
+            # the next poll — the entry stays attached to the handle
+            pass
+
+    def _on_worker_event(self, pool, wid: str, ev: Dict[str, Any]) -> None:
+        handle = pool.workers.get(wid)
+        kind = ev.get("ev")
+        if kind == "ready":
+            if handle is not None and handle.state == "spawning":
+                handle.state = "idle"
+            self.event("worker", event="ready", worker=wid,
+                       pid=ev.get("pid"), pgid=ev.get("pgid"))
+        elif kind == "cell_start":
+            # the worker's per-cell heartbeat: arm the deadline for this
+            # execution unit (re-armed per attempt, so retry backoff
+            # never eats the budget)
+            if handle is not None:
+                handle.cell_label = str(ev.get("label"))
+                handle.cell_cells = max(1, int(ev.get("cells") or 1))
+                handle.cell_start_ts = time.time()
+                ddl = ev.get("deadline_s")
+                handle.cell_deadline_s = (
+                    float(ddl) if ddl
+                    else (float(self.cell_deadline_s)
+                          if self.cell_deadline_s else None)
+                )
+        elif kind == "record":
+            # the worker's telemetry rides the parent's single recorder:
+            # one trace file, no torn multi-process interleaving
+            type_ = str(ev.get("type"))
+            fields = dict(ev.get("fields") or {})
+            self.rec.event(type_, **fields)
+            self.rec.flush()
+            if type_ == "sweep" and handle is not None:
+                if handle.entry is not None:
+                    self.metrics.cell(handle.entry.request_id)
+                self._beat()
+                handle.cells_done += 1
+                # disarm / re-arm: a grouped unit keeps its remaining
+                # budget (cells-1 x deadline from now); the last cell
+                # clears the arm so a slow finalize is never killed
+                if handle.cell_start_ts is not None:
+                    if handle.cell_cells > 1:
+                        handle.cell_cells -= 1
+                        handle.cell_start_ts = time.time()
+                    else:
+                        handle.cell_label = None
+                        handle.cell_start_ts = None
+                        handle.cell_cells = 1
+        elif kind == "done":
+            if handle is not None:
+                self._finish_worker(pool, handle, ev)
+        elif kind == "_eof":
+            if handle is None or handle.state == "dead":
+                return  # the echo of our own kill — already salvaged
+            self._reap_worker(
+                pool, wid, deadline_kill=False,
+                reason="worker process exited unexpectedly",
+                error_type="WorkerCrashed",
+                error="worker process exited unexpectedly mid-request",
+            )
+
+    def _finish_worker(self, pool, handle, ev: Dict[str, Any]) -> None:
+        entry = handle.entry
+        if entry is None:
+            return  # stray done (e.g. raced a kill) — nothing to book
+        rid = entry.request_id
+        wid = handle.wid
+        wall = float(ev.get("wall_s") or 0.0)
+        reply = dict(ev.get("reply") or {})
+        counters = {
+            k: v for k, v in (ev.get("counters") or {}).items()
+        }
+        report = dict(ev.get("report") or {})
+        ledger_entry = handle.ledger
+        # fair share charges the worker-side wall actually consumed
+        self._sched.charge(entry.tenant, wall)
+        if entry.affinity:
+            handle.warm.add(entry.affinity)
+        handle.clear_assignment()
+        handle.state = "idle"
+        handle.served += 1
+        if ev.get("preempted"):
+            self.preemptions += 1
+            self.metrics.preempted(rid)
+            self.event(
+                "request", event="preempted", id=rid,
+                kind=str(entry.request.get("kind")),
+                cells=int(ev.get("cells") or 0),
+                executed=int(report.get("executed") or 0),
+                resumed_cells=int(report.get("resumed_skipped") or 0),
+                preemptions=entry.preemptions + 1,
+                wall_s=round(wall, 6),
+                worker=wid,
+            )
+            if ledger_entry is not None:
+                ledger_entry.ended("finished", metrics={
+                    "preempted": 1,
+                    "executed": int(report.get("executed") or 0),
+                })
+            self._sched.requeue(entry)
+            self.metrics.queue_depth(
+                self._sched.qsize(),
+                by_class=self._sched.depth_by_class(),
+            )
+            return
+        for key in [k for k in self._kills if k[0] == rid]:
+            self._kills.pop(key, None)
+        if reply.get("status") == "error":
+            self.failed += 1
+            error = str(reply.get("error") or "error")[:300]
+            self.event(
+                "request", event="finished", id=rid, outcome="error",
+                error=error, wall_s=round(wall, 6), worker=wid,
+                **self.metrics.finish(rid, outcome="error"),
+            )
+            if ledger_entry is not None:
+                ledger_entry.ended("crashed", error=error)
+        else:
+            if int(ev.get("resumed_pre") or 0):
+                self.resumed_requests += 1
+            quarantined_cells = len(report.get("quarantined") or [])
+            retried = int(report.get("retried") or 0)
+            outcome = "quarantined" if quarantined_cells else "ok"
+            if quarantined_cells:
+                self.quarantined_requests += 1
+            self.served += 1
+            path = self.metrics.get(rid)
+            client = path.client if path is not None else "anon"
+            priority = path.priority if path is not None else "normal"
+            # the worker-reported counter delta closes the lifecycle
+            # path: warm/cold and the build split come from the process
+            # that actually compiled
+            split = self.metrics.finish(
+                rid, outcome=outcome, retried=retried,
+                quarantined_cells=quarantined_cells,
+                counters=counters,
+            )
+            self.event(
+                "request", event="finished", id=rid, outcome=outcome,
+                cells=int(ev.get("cells") or 0),
+                executed=int(report.get("executed") or 0),
+                resumed_cells=int(report.get("resumed_skipped") or 0),
+                quarantined=quarantined_cells, retried=retried,
+                client=client, priority=priority,
+                **(
+                    {"preemptions": entry.preemptions}
+                    if entry.preemptions else {}
+                ),
+                wall_s=round(wall, 6),
+                worker=wid,
+                **split,
+            )
+            if ledger_entry is not None:
+                ledger_entry.ended("finished", metrics={
+                    "cells": int(ev.get("cells") or 0),
+                    "executed": int(report.get("executed") or 0),
+                    "resumed_cells": int(
+                        report.get("resumed_skipped") or 0
+                    ),
+                    "quarantined": quarantined_cells,
+                    "retried": retried,
+                })
+            # per-WORKER warm affinity: repeats of this body route back
+            # to this process, where its engines live
+            self._sched.note_warm(entry.affinity, worker=wid)
+            if ev.get("cache"):
+                self.event("worker", event="done", worker=wid,
+                           request=rid, served=handle.served,
+                           cells_done=handle.cells_done,
+                           cache=ev.get("cache"))
+        self._sched.done(entry)
+        self.spool.complete(rid, reply)
+        with self._state_lock:
+            self._pending_ts.pop(rid, None)
+        if entry.waiter is not None:
+            f, conn = entry.waiter
+            self._reply_and_close(f, conn, reply)
+        self._health()
+
+    def _reap_worker(
+        self,
+        pool,
+        wid: str,
+        *,
+        deadline_kill: bool,
+        reason: str,
+        error_type: str,
+        error: str,
+    ) -> None:
+        """Kill (or bury) one worker, salvage its request, respawn its
+        slot. The supervision primitive escalates SIGTERM → SIGKILL on
+        the whole process group; ``forget_worker`` drops the dead
+        process's warmth claims (its EngineCache died with it)."""
+        handle = pool.workers.get(wid)
+        if handle is None or handle.state == "dead":
+            return
+        cell = handle.cell_label
+        age = (
+            time.time() - handle.cell_start_ts
+            if handle.cell_start_ts is not None else None
+        )
+        info = pool.kill(wid)
+        self.event(
+            "worker",
+            event="kill" if deadline_kill else "crash",
+            worker=wid, pid=handle.proc.pid,
+            reason=reason,
+            escalated=bool(info.get("escalated")),
+            survivors=list(info.get("survivors") or []),
+            **({"request": handle.entry.request_id}
+               if handle.entry is not None else {}),
+            **({"cell": cell} if cell else {}),
+            **({"age_s": round(age, 3)} if age is not None else {}),
+        )
+        if handle.entry is not None:
+            self._salvage(handle, error=error, error_type=error_type)
+        dropped = self._sched.forget_worker(wid)
+        replacement = pool.replace(wid)
+        self.event(
+            "worker", event="replace", worker=replacement.wid,
+            pid=replacement.proc.pid, restarts=pool.restarts,
+            **({"dropped_warm": dropped} if dropped else {}),
+        )
+
+    def _salvage(self, handle, *, error: str, error_type: str) -> None:
+        """A worker died holding a request: charge the slice, advance
+        the kill ladder for the cell it died in, requeue. The journaled
+        cells are already safe on disk — the replacement executes only
+        the remainder, and the merged reply is content-identical to an
+        undisturbed run. At ``attempts`` kills of the SAME cell the
+        parent quarantines it in the journal itself (a deterministic
+        worker-killer must not respawn workers forever); a worker that
+        keeps dying BEFORE any cell starts fails the whole request."""
+        entry = handle.entry
+        rid = entry.request_id
+        cell = handle.cell_label
+        if handle.assigned_ts is not None:
+            self._sched.charge(
+                entry.tenant, time.time() - handle.assigned_ts
+            )
+        if handle.ledger is not None:
+            handle.ledger.ended("crashed", error=error)
+        key = (rid, cell if cell is not None else "__build__")
+        kills = self._kills.get(key, 0) + 1
+        self._kills[key] = kills
+        if kills >= self.attempts:
+            self._kills.pop(key, None)
+            if cell is not None:
+                self._parent_quarantine(
+                    rid, entry.request, cell, error, error_type,
+                    attempts=kills,
+                )
+            else:
+                handle.clear_assignment()
+                self._request_failed(
+                    entry,
+                    error=(
+                        f"{error_type}: worker died {kills}x before any "
+                        f"cell started ({error})"
+                    ),
+                )
+                return
+        handle.clear_assignment()
+        self._sched.requeue(entry, preempted=False)
+        self.metrics.queue_depth(
+            self._sched.qsize(),
+            by_class=self._sched.depth_by_class(),
+        )
+
+    def _parent_quarantine(
+        self,
+        rid: str,
+        request: Dict[str, Any],
+        label: str,
+        error: str,
+        error_type: str,
+        attempts: int,
+    ) -> None:
+        """Quarantine one cell in the request's journal from the PARENT
+        side — the pool's analogue of the in-process ladder exhausting
+        its attempts. The replacement recovers the journaled quarantine
+        (``journal.has`` covers it) and proceeds past the poison cell,
+        salvaging every sibling."""
+        from blades_tpu.sweeps import program_fingerprint
+        from blades_tpu.sweeps.journal import SweepJournal
+
+        journal = SweepJournal(
+            os.path.join(self.out_dir, "requests", rid, "journal.jsonl"),
+            fingerprint=program_fingerprint(request={
+                k: v for k, v in request.items() if k != "id"
+            }),
+            resume=True,
+        )
+        try:
+            if not journal.has(label):
+                journal.record_quarantine(
+                    label, error, error_type, attempts=attempts
+                )
+        finally:
+            journal.close()
+        # same quarantine record the resilient ladder emits — the trace
+        # trail of a parent-quarantined cell reads like any other
+        self.event(
+            "quarantine", sweep="service", cell=label,
+            error=error, error_type=error_type, attempts=attempts,
+        )
+
+    def _request_failed(self, entry, *, error: str) -> None:
+        """Terminal failure decided by the parent (worker death before
+        any cell, attempts exhausted): error reply, books closed, waiter
+        answered — the shape of the in-process error path."""
+        rid = entry.request_id
+        self.failed += 1
+        error = error[:300]
+        reply = {"ok": False, "id": rid, "status": "error",
+                 "error": error}
+        self.event(
+            "request", event="finished", id=rid, outcome="error",
+            error=error,
+            **self.metrics.finish(rid, outcome="error"),
+        )
+        self._sched.done(entry)
+        self.spool.complete(rid, reply)
+        with self._state_lock:
+            self._pending_ts.pop(rid, None)
+        if entry.waiter is not None:
+            f, conn = entry.waiter
+            self._reply_and_close(f, conn, reply)
+
+    def _enforce_deadlines(self, pool) -> None:
+        """The SIGALRM-free deadline: a busy worker whose armed cell has
+        outlived ``deadline x cells + slack`` is group-killed. SIGALRM
+        cannot interrupt a hang inside XLA (the thunk-executor
+        collective-rendezvous deadlock); killing the process group
+        always can — and only this request pays."""
+        now = time.time()
+        for handle in list(pool.busy()):
+            if (
+                handle.cell_start_ts is None
+                or handle.cell_deadline_s is None
+            ):
+                continue
+            budget = (
+                handle.cell_deadline_s * max(1, handle.cell_cells)
+                + DEADLINE_SLACK_S
+            )
+            age = now - handle.cell_start_ts
+            if age <= budget:
+                continue
+            label = handle.cell_label
+            self._reap_worker(
+                pool, handle.wid, deadline_kill=True,
+                reason="deadline",
+                error_type="CellDeadlineExceeded",
+                error=(
+                    f"cell {label!r} exceeded its parent-enforced "
+                    f"deadline ({age:.1f}s > {budget:.1f}s budget)"
+                ),
+            )
+
+    def _maybe_yield(self, pool) -> None:
+        """Relay the preemption signal: when strictly-higher-priority
+        work waits and NO worker is idle to take it, ask each busy
+        worker running lower-priority work to yield at its next cell
+        boundary (idempotent — re-sent each tick while the condition
+        holds)."""
+        if pool.idle():
+            return
+        for handle in pool.busy():
+            entry = handle.entry
+            if entry is not None and self._sched.waiting_above(
+                entry.priority
+            ):
+                pool.send(handle.wid, {"op": "yield"})
+
     def _shutdown_listener(self) -> None:
         """Stop accepting: close the socket and join the listener thread
         (idempotent). After this returns, no new request can enter the
@@ -990,6 +1528,7 @@ class SimulationService:
                 "max_queue": self.max_queue,
                 "attempts": self.attempts,
                 "cell_deadline_s": self.cell_deadline_s,
+                "workers": self.workers,
             },
             artifacts=[
                 os.path.join(self.out_dir, TRACE_NAME),
@@ -1035,6 +1574,18 @@ class SimulationService:
             resumed=len(pending), pid=os.getpid(),
         )
 
+        if self.workers > 0:
+            # spawn the pool BEFORE listening: workers import jax-free
+            # and send `ready` within interpreter-import time, so the
+            # first admitted request never races an empty pool for long
+            from blades_tpu.service.workers import WorkerPool
+
+            self._pool = WorkerPool(self.workers, self.out_dir)
+            self._pool.start()
+            for h in self._pool.workers.values():
+                self.event("worker", event="spawn", worker=h.wid,
+                           pid=h.proc.pid, pgid=h.pgid)
+
         try:
             os.unlink(self.socket_path)
         except OSError:
@@ -1051,7 +1602,10 @@ class SimulationService:
 
         outcome = "finished"
         try:
-            snap = self._work()
+            snap = (
+                self._work_pool() if self._pool is not None
+                else self._work()
+            )
         except BaseException as e:
             outcome = "crashed"
             ledger_entry.ended("crashed", error=f"{type(e).__name__}: {e}")

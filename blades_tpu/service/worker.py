@@ -1,0 +1,399 @@
+"""One worker process of the service execution pool: the jax-blast
+radius of exactly one request.
+
+Spawned by :class:`blades_tpu.service.workers.WorkerPool` as its own
+session/process group (``python -m blades_tpu.service.worker``), this
+process owns a private :class:`~blades_tpu.sweeps.EngineCache` + dataset
+cache and executes requests the parent dispatches over an NDJSON pipe
+protocol — the Ray-actor shape (SURVEY §0: a dead actor doesn't kill
+the driver) rebuilt on pipes and process groups:
+
+- **parent → worker** (stdin): ``{"op": "assign", "id", "request",
+  "options"}`` runs one request; ``{"op": "yield"}`` asks the resilient
+  ladder to stop at the next cell boundary (the scheduler's preemption
+  signal, relayed); ``{"op": "shutdown"}`` (or EOF) exits cleanly.
+- **worker → parent** (stdout): ``{"ev": "ready"}`` once importable;
+  ``{"ev": "cell_start", "label", "cells"}`` immediately before every
+  execution attempt — the per-cell heartbeat the parent arms its
+  deadline ladder on; ``{"ev": "record", "type", "fields"}`` for every
+  schema-locked telemetry record the resilient ladder produces (the
+  parent re-emits them on the single service trace — one recorder, no
+  torn multi-process trace files); ``{"ev": "done", "id", "reply",
+  ...}`` with the same reply dict the in-process path builds.
+
+Deadlines here are **external** (:class:`~blades_tpu.sweeps.resilient
+.ResilienceOptions` ``deadline="external"``): no SIGALRM anywhere in
+this process. A cell that hangs inside XLA (the thunk-executor
+collective-rendezvous deadlock, CLAUDE.md) simply stops beating; the
+PARENT kills this whole process group with the supervision module's
+SIGTERM→SIGKILL escalation and re-runs the journaled remainder on a
+replacement worker — the hang is contained to one request, not the
+server.
+
+Crash containment relies on the shared per-request
+:class:`~blades_tpu.sweeps.journal.SweepJournal` (O_APPEND + flock,
+same path the in-process executor uses): every completed cell is
+journaled before it is reported, so whatever kills this process, the
+replacement recovers the journal and executes ONLY the remainder — the
+PR 13 resume invariant, now exercised by worker death.
+
+The protocol channel is a dup of the original stdout; fd 1 itself is
+re-pointed at stderr before any request executes, so a library that
+prints (jax warnings, a driver's progress line) can never corrupt the
+framing.
+
+Module scope is stdlib-only (IMP001): a worker serving probe requests
+never imports jax, so pool spawn is interpreter-import fast and the
+first simulate cell pays the jax import lazily, exactly like the
+in-process server.
+
+Reference counterpart: the Ray actor loop in
+``src/blades/simulator.py`` (N actors each serially processing K/N
+clients); here one actor-equivalent per REQUEST, with explicit
+supervision instead of Ray's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from blades_tpu.telemetry import context as _context
+from blades_tpu.telemetry import recorder as _trecorder
+
+__all__ = ["main"]
+
+#: Set by the pool on spawn: this worker's id ("w0", "w1", ...).
+WORKER_ID_ENV = "BLADES_WORKER_ID"
+
+
+class _Pipe:
+    """The worker's half of the NDJSON protocol: one locked writer over
+    the dup'd original stdout (protocol frames must never interleave —
+    the executor's record forwarding and the main loop's done events can
+    race only if a future change adds emitting threads; the lock makes
+    that a non-event)."""
+
+    def __init__(self, fh):
+        self._fh = fh
+        self._lock = threading.Lock()
+
+    def send(self, ev: Dict[str, Any]) -> None:
+        line = json.dumps(ev, default=str)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+
+class _ForwardingRecorder:
+    """Recorder facade for the resilient executor: every schema-locked
+    event (retry / quarantine / resume / deadline_unenforced) becomes a
+    ``record`` frame the parent re-emits on the real service trace.
+    ``flush`` is a no-op — ``send`` already writes through (the pipe IS
+    the flush boundary)."""
+
+    def __init__(self, pipe: _Pipe):
+        self._pipe = pipe
+
+    def event(self, type_: str, **fields) -> None:
+        self._pipe.send({"ev": "record", "type": type_, "fields": fields})
+
+    def flush(self) -> None:
+        pass
+
+
+class _WorkerAccounting:
+    """The worker-side mirror of the server's ``_RequestAccounting``:
+    same ``sweep`` record fields (cell key ``<request_id>/<label>``,
+    i-of-N, wall/execute split, counter delta), emitted as ``record``
+    frames instead of recorder events. The parent re-emits each on the
+    service trace, ticks the request-path cell counter, and beats the
+    supervision heartbeat — so a pooled request's trace/metrics trail is
+    field-identical to an in-process one."""
+
+    kind = "service"
+
+    def __init__(self, pipe: _Pipe, request_id: str, total: int):
+        self.rec = _ForwardingRecorder(pipe)
+        self.request_id = request_id
+        self.total = int(total)
+        self.done = 0
+
+    def record(
+        self,
+        key: str,
+        wall_s: float,
+        counter_delta: Optional[Dict[str, Any]] = None,
+        **fields,
+    ) -> None:
+        error = fields.pop("error", None)
+        error_type = fields.pop("error_type", None)
+        delta = dict(counter_delta or {})
+        self.done += 1
+        rec_fields: Dict[str, Any] = {
+            "sweep": self.kind,
+            "cell": f"{self.request_id}/{key}",
+            "ts": time.time(),
+            "i": self.done,
+            "total": self.total,
+            "wall_s": round(float(wall_s), 6),
+            "execute_s": round(
+                max(0.0, wall_s - delta.get("compile_s", 0.0)
+                    - delta.get("trace_s", 0.0)), 6,
+            ),
+            **delta,
+            **fields,
+        }
+        if error is not None:
+            rec_fields["ok"] = False
+            rec_fields["error"] = str(error)[:300]
+            if error_type is not None:
+                rec_fields.setdefault("error_type", error_type)
+        self.rec.event("sweep", **rec_fields)
+
+    def resume(self, skipped: int, journal: Optional[str] = None,
+               quarantined: int = 0) -> None:
+        fields: Dict[str, Any] = {
+            "sweep": self.kind,
+            "skipped": int(skipped),
+            "total": self.total,
+            "ts": time.time(),
+        }
+        if quarantined:
+            fields["quarantined"] = int(quarantined)
+        if journal:
+            fields["journal"] = str(journal)
+        self.rec.event("resume", **fields)
+
+
+def _execute(
+    rid: str,
+    request: Dict[str, Any],
+    opts: Dict[str, Any],
+    state: Dict[str, Any],
+    pipe: _Pipe,
+    yield_flag: threading.Event,
+) -> Dict[str, Any]:
+    """One request through the resilient ladder — the worker-side core
+    of ``SimulationService._execute``, minus the server bookkeeping the
+    parent keeps (lifecycle path, ledger, served/failed counters, spool,
+    waiter replies). Returns the ``done`` frame body; never raises."""
+    from blades_tpu.service import handlers as _handlers
+    from blades_tpu.sweeps import program_fingerprint
+    from blades_tpu.sweeps.journal import SweepJournal
+    from blades_tpu.sweeps.resilient import ResilienceOptions
+
+    t0 = time.perf_counter()
+    counters0 = _trecorder.process_counters()
+
+    def _counters() -> Dict[str, Any]:
+        after = _trecorder.process_counters()
+        return {
+            k: after.get(k, 0) - counters0.get(k, 0)
+            for k in set(after) | set(counters0)
+        }
+
+    if state.get("cache") is None:
+        from blades_tpu.sweeps import EngineCache
+
+        state["cache"] = EngineCache()
+    ctx = {
+        "cache": state["cache"],
+        "datasets": state["datasets"],
+        "out_dir": state["out_dir"],
+        "request_id": rid,
+    }
+    try:
+        plan = _handlers.build_plan(request, ctx)
+    except (ValueError, TypeError) as e:
+        error = f"{type(e).__name__}: {e}"[:300]
+        return {
+            "id": rid,
+            "reply": {"ok": False, "id": rid, "status": "error",
+                      "error": error},
+            "wall_s": round(time.perf_counter() - t0, 6),
+            "counters": _counters(),
+        }
+    labels = plan.labels
+    # the SAME journal path as the in-process executor: whatever killed
+    # the previous attempt (worker death included), this execution
+    # recovers its journaled cells and runs only the remainder
+    journal = SweepJournal(
+        os.path.join(state["out_dir"], "requests", rid, "journal.jsonl"),
+        fingerprint=program_fingerprint(request={
+            k: v for k, v in request.items() if k != "id"
+        }),
+        resume=True,
+    )
+    resumed_pre = sum(1 for lab in labels if journal.has(lab))
+    acct = _WorkerAccounting(pipe, rid, total=len(labels))
+    opt_kw: Dict[str, Any] = {
+        "attempts": int(opts.get("attempts", 2)),
+        "base_delay_s": float(opts.get("base_delay_s", 0.5)),
+        "cell_deadline_s": opts.get("cell_deadline_s"),
+    }
+    opt_kw.update(plan.resilience_kw or {})
+    # the pool contract: the PARENT enforces the deadline by killing
+    # this process group — no SIGALRM in here, and no unenforced note
+    # (the deadline IS enforced, one level up)
+    opt_kw["deadline"] = "external"
+    opt_kw["should_yield"] = yield_flag.is_set
+    # the frame carries the EFFECTIVE deadline (plan override included):
+    # the parent arms its enforcement with the budget the plan asked
+    # for, not just the server-level default
+    _cell_ddl = opt_kw.get("cell_deadline_s")
+    opt_kw["on_cell_start"] = lambda label, cells: pipe.send({
+        "ev": "cell_start", "id": rid, "label": label,
+        "cells": int(cells), "ts": time.time(),
+        **({"deadline_s": float(_cell_ddl)} if _cell_ddl else {}),
+    })
+    options = ResilienceOptions(**opt_kw)
+    try:
+        results, walls, report = plan.execute(
+            sweep=acct, journal=journal, options=options,
+        )
+        if report.preempted:
+            return {
+                "id": rid,
+                "reply": {"ok": True, "id": rid, "status": "preempted",
+                          "executed": report.executed},
+                "report": report.summary(),
+                "preempted": True,
+                "resumed_pre": resumed_pre,
+                "cells": len(labels),
+                "wall_s": round(time.perf_counter() - t0, 6),
+                "counters": _counters(),
+            }
+        extra = (
+            plan.finalize(results, walls, report)
+            if plan.finalize else {}
+        )
+    except Exception as e:  # noqa: BLE001 - isolation: reply, don't die
+        error = f"{type(e).__name__}: {e}"[:300]
+        return {
+            "id": rid,
+            "reply": {"ok": False, "id": rid, "status": "error",
+                      "error": error},
+            "resumed_pre": resumed_pre,
+            "cells": len(labels),
+            "wall_s": round(time.perf_counter() - t0, 6),
+            "counters": _counters(),
+        }
+    finally:
+        journal.close()
+    quarantined = {q["cell"]: q for q in report.quarantined}
+    out_cells = []
+    for label, res in zip(labels, results):
+        if res is None:
+            q = quarantined.get(label, {})
+            out_cells.append({
+                "label": label,
+                "quarantined": True,
+                "error": q.get("error", "quarantined"),
+                "error_type": q.get("error_type", "Exception"),
+            })
+        elif plan.slim_cells:
+            out_cells.append({"label": label})
+        else:
+            out_cells.append({"label": label, "result": res})
+    cache = state.get("cache")
+    return {
+        "id": rid,
+        "reply": {
+            "ok": not quarantined,
+            "id": rid,
+            "status": "done",
+            "kind": request.get("kind"),
+            "cells": out_cells,
+            "summary": report.summary(),
+            **extra,
+        },
+        "report": report.summary(),
+        "resumed_pre": resumed_pre,
+        "cells": len(labels),
+        "wall_s": round(time.perf_counter() - t0, 6),
+        "counters": _counters(),
+        "cache": cache.stats() if cache is not None else None,
+    }
+
+
+def _reader(stdin, inbox, yield_flag: threading.Event) -> None:
+    """Drain parent frames into the inbox. ``yield`` is handled HERE —
+    the main thread is busy executing when a preemption arrives, and the
+    whole point is flipping the flag its ladder polls mid-request."""
+    import queue as _queue  # local: keep module scope lean
+
+    assert isinstance(inbox, _queue.Queue)
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            msg = json.loads(line)
+        except ValueError:
+            continue  # a torn frame is the parent's problem, not fatal
+        if msg.get("op") == "yield":
+            yield_flag.set()
+        else:
+            inbox.put(msg)
+    inbox.put(None)  # EOF: parent is gone — exit the main loop
+
+
+def main(argv=None) -> int:
+    import queue as _queue
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", required=True,
+                   help="the service --out directory (shared journals)")
+    args = p.parse_args(argv)
+
+    # protocol channel = dup of the real stdout; fd 1 then points at
+    # stderr so stray library prints can never corrupt the framing
+    proto = os.fdopen(os.dup(1), "w", buffering=1)
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+
+    _context.activate()  # inherit the parent's run_id/attempt (env)
+    pipe = _Pipe(proto)
+    yield_flag = threading.Event()
+    inbox: Any = _queue.Queue()
+    t = threading.Thread(
+        target=_reader, args=(sys.stdin, inbox, yield_flag),
+        name="worker-reader", daemon=True,
+    )
+    t.start()
+
+    state: Dict[str, Any] = {
+        "cache": None,
+        "datasets": {},
+        "out_dir": args.out,
+    }
+    pipe.send({
+        "ev": "ready",
+        "worker": os.environ.get(WORKER_ID_ENV),
+        "pid": os.getpid(),
+        "pgid": os.getpgid(0),
+    })
+    while True:
+        msg = inbox.get()
+        if msg is None or msg.get("op") == "shutdown":
+            break
+        if msg.get("op") != "assign":
+            continue
+        rid = str(msg.get("id"))
+        yield_flag.clear()  # a stale yield must not preempt a fresh slice
+        done = _execute(
+            rid, msg.get("request") or {}, msg.get("options") or {},
+            state, pipe, yield_flag,
+        )
+        pipe.send({"ev": "done", **done})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

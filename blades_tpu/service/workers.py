@@ -1,0 +1,354 @@
+"""Worker-process pool: spawn, dispatch, liveness, group-kill, replace.
+
+The mechanics half of the service's worker pool (the POLICY half — what
+to dispatch, when a deadline has expired, how to salvage a killed
+worker's request — lives in ``SimulationService._work_pool``,
+``blades_tpu/service/server.py``). One :class:`WorkerPool` owns W
+:class:`WorkerHandle` s, each wrapping one ``python -m
+blades_tpu.service.worker`` child:
+
+- **spawn**: ``start_new_session=True`` — every worker is its own
+  session/process group, so the supervision module's
+  :func:`~blades_tpu.supervision.supervisor.kill_process_group`
+  (SIGTERM → SIGCONT → grace → SIGKILL, then a ``/proc`` survivor scan)
+  can reap it AND anything it forked, without ever signaling the
+  server's own group. Worker stderr appends to
+  ``<out>/workers/<wid>.err`` (protocol frames ride stdout; stray
+  library prints land here).
+- **events**: one reader thread per worker drains its stdout frames
+  into a single queue the dispatch loop polls — every frame doubles as
+  a liveness beat (``last_event_ts``); EOF enqueues a synthetic
+  ``_eof`` frame, so a crashed worker is detected at the next poll, not
+  at the next write.
+- **deadline arming**: a worker's ``cell_start`` frame stamps
+  ``cell_label``/``cell_start_ts``/``cell_cells`` on its handle; the
+  server's enforcement pass compares ``now - cell_start_ts`` against
+  ``cell_deadline_s x cell_cells`` + slack and calls :meth:`kill` — the
+  SIGALRM-free deadline the pool exists for (SIGALRM cannot interrupt a
+  hang inside XLA; killing the process group always can).
+- **replace**: a killed/crashed worker's slot respawns immediately
+  (``restarts`` counts lifetime replacements); the warm-affinity set
+  dies with the process — the replacement is cold by construction, and
+  the scheduler's per-worker warm routing reflects that.
+- **shutdown**: drain-ordered — ``shutdown`` frames first (a clean
+  worker exits on its own), then group-kill stragglers, then a
+  ``/proc`` scan asserting ZERO survivors across every group this pool
+  ever spawned (the zero-orphans acceptance bar).
+
+Stdlib-only and importable before jax (IMP001): the pool spawns and
+supervises probe-only workers without the parent ever importing jax.
+
+Reference counterpart: Ray's actor supervision in
+``src/blades/simulator.py`` (actor death handled by the framework);
+here the supervision is explicit, journal-backed, and measured.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from blades_tpu.service.worker import WORKER_ID_ENV
+from blades_tpu.supervision.supervisor import (
+    kill_process_group,
+    list_group,
+)
+
+__all__ = ["WorkerHandle", "WorkerPool"]
+
+
+class WorkerHandle:
+    """One worker child and everything the dispatch loop tracks on it."""
+
+    def __init__(self, wid: str, proc: subprocess.Popen, pgid: int):
+        self.wid = wid
+        self.proc = proc
+        self.pgid = pgid
+        self.state = "spawning"  # -> idle -> busy -> dead
+        self.spawned_ts = time.time()
+        self.last_event_ts = self.spawned_ts
+        #: the in-flight ScheduledRequest (opaque to this module)
+        self.entry: Any = None
+        self.assigned_ts: Optional[float] = None
+        #: parent-side ledger entry for the in-flight request
+        self.ledger: Any = None
+        #: current execution unit (armed by the worker's cell_start
+        #: frame, cleared by its sweep record = the unit completed)
+        self.cell_label: Optional[str] = None
+        self.cell_cells: int = 1
+        self.cell_start_ts: Optional[float] = None
+        #: the effective per-cell deadline for the armed unit (from the
+        #: cell_start frame — the WORKER knows the plan's override)
+        self.cell_deadline_s: Optional[float] = None
+        #: lifetime accounting for the health surface
+        self.cells_done = 0
+        self.served = 0
+        #: request-body affinity fingerprints completed on THIS process
+        #: (the scheduler's per-worker warm routing input; dies with it)
+        self.warm: Set[str] = set()
+
+    def clear_assignment(self) -> None:
+        self.entry = None
+        self.assigned_ts = None
+        self.ledger = None
+        self.cell_label = None
+        self.cell_cells = 1
+        self.cell_start_ts = None
+        self.cell_deadline_s = None
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
+        now = now if now is not None else time.time()
+        out: Dict[str, Any] = {
+            "state": self.state,
+            "pid": self.proc.pid,
+            "cells_done": self.cells_done,
+            "served": self.served,
+            "warm": len(self.warm),
+        }
+        if self.entry is not None:
+            out["request"] = getattr(self.entry, "request_id", None)
+            if self.assigned_ts is not None:
+                out["request_age_s"] = round(now - self.assigned_ts, 3)
+        if self.cell_label is not None and self.cell_start_ts is not None:
+            out["cell"] = self.cell_label
+            out["cell_age_s"] = round(now - self.cell_start_ts, 3)
+        return out
+
+
+class WorkerPool:
+    """W supervised worker processes + one event queue (see module
+    docstring). ``term_grace_s``/``kill_wait_s`` size the SIGTERM →
+    SIGKILL escalation; they default low because a worker the parent
+    kills is by definition hung or expendable — its journaled work is
+    already safe on disk."""
+
+    def __init__(
+        self,
+        size: int,
+        out_dir: str,
+        term_grace_s: float = 2.0,
+        kill_wait_s: float = 10.0,
+    ):
+        self.size = int(size)
+        self.out_dir = out_dir
+        self.term_grace_s = float(term_grace_s)
+        self.kill_wait_s = float(kill_wait_s)
+        self.workers: Dict[str, WorkerHandle] = {}
+        self.events: "queue.Queue[Tuple[str, Dict[str, Any]]]" = (
+            queue.Queue()
+        )
+        self.restarts = 0
+        self.kills = 0
+        self._spawned_pgids: Set[int] = set()
+        self._seq = 0
+        os.makedirs(os.path.join(out_dir, "workers"), exist_ok=True)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        for _ in range(self.size):
+            self.spawn()
+
+    def spawn(self) -> WorkerHandle:
+        wid = f"w{self._seq}"
+        self._seq += 1
+        env = dict(os.environ)
+        env[WORKER_ID_ENV] = wid
+        err = open(
+            os.path.join(self.out_dir, "workers", f"{wid}.err"), "ab"
+        )
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "blades_tpu.service.worker",
+                 "--out", self.out_dir],
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=err,
+                env=env,
+                start_new_session=True,
+                text=True,
+                bufsize=1,
+            )
+        finally:
+            err.close()  # the child holds its own fd now
+        try:
+            pgid = os.getpgid(proc.pid)
+        except OSError:
+            pgid = proc.pid
+        handle = WorkerHandle(wid, proc, pgid)
+        self.workers[wid] = handle
+        self._spawned_pgids.add(pgid)
+        threading.Thread(
+            target=self._read, args=(handle,),
+            name=f"worker-reader-{wid}", daemon=True,
+        ).start()
+        return handle
+
+    def replace(self, wid: str) -> WorkerHandle:
+        """Respawn a dead worker's slot (the dead handle stays in
+        ``workers`` as forensics until shutdown? no — it is dropped:
+        the health surface reports live slots + lifetime restarts)."""
+        self.workers.pop(wid, None)
+        self.restarts += 1
+        return self.spawn()
+
+    def _read(self, handle: WorkerHandle) -> None:
+        stdout = handle.proc.stdout
+        assert stdout is not None
+        for line in stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue  # a torn frame must not kill the reader
+            self.events.put((handle.wid, ev))
+        self.events.put((handle.wid, {"ev": "_eof"}))
+
+    # -- messaging -------------------------------------------------------------
+
+    def send(self, wid: str, msg: Dict[str, Any]) -> bool:
+        handle = self.workers.get(wid)
+        if handle is None or handle.proc.stdin is None:
+            return False
+        try:
+            handle.proc.stdin.write(json.dumps(msg, default=str) + "\n")
+            handle.proc.stdin.flush()
+            return True
+        except (OSError, ValueError):
+            return False  # dead pipe: the _eof frame carries the news
+
+    def poll(self, timeout: float) -> List[Tuple[str, Dict[str, Any]]]:
+        """Every queued (wid, frame) pair, blocking up to ``timeout`` for
+        the first. Stamps liveness on the handle."""
+        out: List[Tuple[str, Dict[str, Any]]] = []
+        try:
+            out.append(self.events.get(timeout=max(0.0, timeout)))
+        except queue.Empty:
+            return out
+        while True:
+            try:
+                out.append(self.events.get_nowait())
+            except queue.Empty:
+                break
+        now = time.time()
+        for wid, _ in out:
+            handle = self.workers.get(wid)
+            if handle is not None:
+                handle.last_event_ts = now
+        return out
+
+    # -- introspection ---------------------------------------------------------
+
+    def idle(self) -> List[WorkerHandle]:
+        return [h for h in self.workers.values() if h.state == "idle"]
+
+    def busy(self) -> List[WorkerHandle]:
+        return [h for h in self.workers.values() if h.state == "busy"]
+
+    def any_busy(self) -> bool:
+        return any(
+            h.state in ("busy", "spawning") and h.entry is not None
+            for h in self.workers.values()
+        ) or bool(self.busy())
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``workers`` health block (``op: status`` / ``op:
+        metrics`` / the ``service`` health record): pool size, busy/idle
+        split, lifetime restarts + kills, and per-worker state incl. the
+        in-flight cell's age — a hung worker is attributable from this
+        surface alone."""
+        now = time.time()
+        # dict(self.workers) is a GIL-atomic copy: this is called from
+        # the listener thread (op: status/metrics) while the dispatch
+        # loop replaces dead workers
+        workers = dict(self.workers)
+        by_worker = {
+            wid: h.snapshot(now) for wid, h in sorted(workers.items())
+        }
+        return {
+            "size": self.size,
+            "busy": sum(1 for h in workers.values()
+                        if h.state == "busy"),
+            "idle": sum(1 for h in workers.values()
+                        if h.state == "idle"),
+            "restarts": self.restarts,
+            "kills": self.kills,
+            "by_worker": by_worker,
+        }
+
+    # -- kill / shutdown -------------------------------------------------------
+
+    def kill(self, wid: str) -> Dict[str, Any]:
+        """Group-kill one worker (SIGTERM → grace → SIGKILL via the
+        supervision primitive); returns its forensics dict. The handle
+        goes ``dead``; the caller salvages its request and calls
+        :meth:`replace`."""
+        handle = self.workers.get(wid)
+        if handle is None:
+            return {"pgid": None, "escalated": False, "survivors": []}
+        self.kills += 1
+        info = kill_process_group(
+            handle.proc, term_grace_s=self.term_grace_s,
+            kill_wait_s=self.kill_wait_s,
+        )
+        handle.state = "dead"
+        self._close_pipes(handle)
+        return info
+
+    def _close_pipes(self, handle: WorkerHandle) -> None:
+        for fh in (handle.proc.stdin, handle.proc.stdout):
+            if fh is not None:
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+
+    def orphans(self) -> List[int]:
+        """Live pids in ANY process group this pool ever spawned — the
+        zero-orphans invariant's measurement (``/proc`` scan, zombies
+        excluded)."""
+        pids: List[int] = []
+        for pgid in self._spawned_pgids:
+            pids.extend(list_group(pgid))
+        return pids
+
+    def shutdown(self, timeout: float = 10.0) -> Dict[str, Any]:
+        """Drain-ordered teardown: ask every live worker to exit, wait,
+        group-kill stragglers, verify zero survivors."""
+        for wid in list(self.workers):
+            self.send(wid, {"op": "shutdown"})
+        deadline = time.monotonic() + max(0.0, timeout)
+        for handle in self.workers.values():
+            if handle.state == "dead":
+                continue
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                handle.proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                kill_process_group(
+                    handle.proc, term_grace_s=self.term_grace_s,
+                    kill_wait_s=self.kill_wait_s,
+                )
+                self.kills += 1
+            handle.state = "dead"
+            self._close_pipes(handle)
+        survivors = self.orphans()
+        for pid in survivors:
+            # belt and braces: nothing this pool spawned may outlive it
+            try:
+                os.kill(pid, 9)
+            except OSError:
+                pass
+        return {
+            "restarts": self.restarts,
+            "kills": self.kills,
+            "survivors": survivors,
+        }

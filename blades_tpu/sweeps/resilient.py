@@ -161,7 +161,23 @@ class ResilienceOptions:
     service scheduler's cell-boundary preemption,
     ``blades_tpu/service/scheduler.py``). The one-unit-of-progress
     floor makes preemption livelock-free by construction: every slice
-    completes at least one journaled cell."""
+    completes at least one journaled cell.
+
+    ``deadline``: who enforces ``cell_deadline_s``. ``"alarm"`` (the
+    default) arms the in-process SIGALRM soft deadline — usable only
+    from the main thread; when it is NOT usable the executor emits an
+    explicit ``deadline_unenforced`` record instead of silently running
+    unbounded. ``"external"`` declares that a supervising parent owns
+    the deadline (the worker pool,
+    ``blades_tpu/service/workers.py``): the executor skips SIGALRM
+    entirely — and skips the unenforced note, because the deadline IS
+    enforced, just not here.
+
+    ``on_cell_start(label, cells)``: called immediately before every
+    execution attempt with the cell label (or the first label of a
+    batched group) and the unit's cell count. The worker pool's per-cell
+    heartbeat: the worker forwards it over its pipe so the parent can
+    arm the external deadline for exactly this unit."""
 
     attempts: int = 2
     base_delay_s: float = 0.5
@@ -170,12 +186,26 @@ class ResilienceOptions:
     sleep: Callable[[float], None] = time.sleep
     runner: Optional[Callable[[Sequence[SweepCell], str], list]] = None
     should_yield: Optional[Callable[[], bool]] = None
+    deadline: str = "alarm"
+    on_cell_start: Optional[Callable[[str, int], None]] = None
 
     def __post_init__(self):
         # a non-positive budget would skip the attempt loop entirely and
         # quarantine every cell with a fabricated error — and the
         # poisoned quarantines would persist in the journal
         self.attempts = max(1, int(self.attempts))
+        if self.deadline not in ("alarm", "external"):
+            raise ValueError(
+                f"deadline must be 'alarm' or 'external', got "
+                f"{self.deadline!r}"
+            )
+
+    def alarm_deadline_s(self) -> Optional[float]:
+        """The per-cell deadline the IN-PROCESS soft alarm should arm —
+        ``None`` under external enforcement."""
+        if self.deadline == "external":
+            return None
+        return self.cell_deadline_s
 
 
 @dataclasses.dataclass
@@ -211,6 +241,30 @@ class ResilienceReport:
 # One implementation each, used by BOTH executors, so retry/quarantine/
 # resume trails are identical across the batched and per-cell paths by
 # construction (the docstring contract tests/test_resilient.py pins).
+
+
+def _note_deadline_unenforced(
+    rec, kind: str, *, deadline_s: float,
+) -> None:
+    """The satellite fix for the silent-deadline hole: a caller asked
+    for an in-process (``deadline="alarm"``) per-cell deadline that
+    SIGALRM cannot enforce here (non-main-thread caller, or a platform
+    without ``setitimer``). Before this note, the deadline silently
+    vanished — a hung cell ran unbounded and the trace showed a sweep
+    that LOOKED deadline-protected. Now the trail says so explicitly
+    (surfaced by ``scripts/sweep_status.py``)."""
+    reason = (
+        "no_setitimer" if not hasattr(signal, "setitimer")
+        else "non_main_thread"
+    )
+    rec.event(
+        "deadline_unenforced",
+        sweep=kind,
+        reason=reason,
+        deadline_s=float(deadline_s),
+        ts=time.time(),
+    )
+    rec.flush()  # a live status query must see the downgrade
 
 
 def _emit_retry(
@@ -346,6 +400,13 @@ def run_cells_resilient(
     walls: List[float] = []
     report = ResilienceReport()
 
+    cell_ddl = options.alarm_deadline_s()
+    if cell_ddl and not _alarm_usable():
+        # once per execution, not per cell: the condition is a property
+        # of the calling context, and a 100-cell sweep must not bury the
+        # trail under 100 identical notes
+        _note_deadline_unenforced(rec, kind, deadline_s=cell_ddl)
+
     progressed = 0
     for label, payload in cells:
         if journal is not None and journal.has(label):
@@ -375,10 +436,15 @@ def run_cells_resilient(
         wall = 0.0
         delta: Dict[str, Any] = {}
         for attempt in range(1, options.attempts + 1):
+            if options.on_cell_start is not None:
+                # per attempt, not per cell: the external enforcer's
+                # timer must re-arm after a backoff sleep, or the sleep
+                # itself would eat the next attempt's budget
+                options.on_cell_start(label, 1)
             t0 = time.perf_counter()
             counters0 = _trecorder.process_counters()
             try:
-                with soft_deadline(options.cell_deadline_s):
+                with soft_deadline(cell_ddl):
                     out = run_cell(payload)
                 wall = time.perf_counter() - t0
                 delta = _counter_delta(counters0)
@@ -462,19 +528,24 @@ def run_grouped_resilient(
         )
     )
 
+    _grp_ddl = options.alarm_deadline_s()
+    if _grp_ddl and not _alarm_usable():
+        # same once-per-execution note as the per-cell executor (the
+        # shared-primitives contract: identical trails by construction)
+        _note_deadline_unenforced(rec, kind, deadline_s=_grp_ddl)
+
     def _attempt(idxs: List[int], key: str, attempts: int, fail: dict):
         """Run one subgroup with retry; returns (outs, wall, delta,
         retries_used) or raises the final failure, leaving the final
         attempt's wall/counters in ``fail`` so the quarantine record can
         carry the real failure cost."""
         group = [cells[i] for i in idxs]
-        ddl = (
-            options.cell_deadline_s * len(group)
-            if options.cell_deadline_s
-            else None
-        )
+        cell_ddl = options.alarm_deadline_s()
+        ddl = cell_ddl * len(group) if cell_ddl else None
         last: Optional[BaseException] = None
         for attempt in range(1, attempts + 1):
+            if options.on_cell_start is not None:
+                options.on_cell_start(group[0].label, len(group))
             t0 = time.perf_counter()
             counters0 = _trecorder.process_counters()
             try:

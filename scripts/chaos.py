@@ -989,15 +989,135 @@ def _scn_preempt_resume(out_dir: str) -> dict:
         _finish_server(proc, client)
 
 
+def _scn_worker_crash(out_dir: str) -> dict:
+    """``os.abort()`` mid-cell inside a worker process (``--workers 1``):
+    the SERVER stays up (only the worker dies), a follow-up request is
+    served normally, the replacement worker executes ONLY the cells the
+    dead worker had not journaled, and the reply is content-identical to
+    an undisturbed run of the same request."""
+    # the `once` sentinel arms the saboteur exactly once: the retried
+    # attempt (sentinel present) behaves, exactly like the kill/hang
+    # saboteurs in child_main above
+    sentinel = os.path.join(out_dir, "worker_crash.once")
+    request = {"kind": "probe", "cells": [
+        {"label": "c0", "op": "ok", "value": 0},
+        {"label": "boom", "op": "abort", "once": sentinel, "value": 1},
+        {"label": "c2", "op": "ok", "value": 2},
+    ]}
+    # reference: sentinel pre-created => the abort cell behaves; the
+    # reply this run returns is what the disturbed run must reproduce
+    open(sentinel, "w").close()
+    proc, client = _start_server(
+        os.path.join(out_dir, "wcrash_ref"), ("--workers", "1"),
+    )
+    try:
+        ref = client.submit(request, request_id="wcrash-ref", timeout=120)
+    finally:
+        _finish_server(proc, client)
+    os.unlink(sentinel)
+
+    proc, client = _start_server(
+        os.path.join(out_dir, "wcrash"), ("--workers", "1"),
+    )
+    try:
+        hurt = client.submit(request, request_id="wcrash", timeout=120)
+        after = client.submit(
+            {"kind": "probe", "cells": [{"label": "a", "op": "ok"}]},
+            timeout=60,
+        )
+        status = client.status()
+        workers = status.get("workers") or {}
+        summary = hurt.get("summary") or {}
+        content_identical = hurt.get("cells") == ref.get("cells")
+        ok = (
+            ref.get("status") == "done" and ref.get("ok")
+            and hurt.get("status") == "done" and hurt.get("ok")
+            and content_identical
+            # the replacement ran ONLY the unjournaled remainder: the
+            # journaled prefix came back as resumed_skipped, never re-run
+            and summary.get("resumed_skipped", 0) >= 1
+            and summary.get("executed", 9) <= len(request["cells"]) - 1
+            and after.get("ok")
+            and workers.get("restarts", 0) >= 1
+        )
+        return {"name": "worker_crash", "ok": bool(ok),
+                "content_identical": bool(content_identical),
+                "resumed_skipped": summary.get("resumed_skipped"),
+                "executed": summary.get("executed"),
+                "restarts": workers.get("restarts")}
+    finally:
+        _finish_server(proc, client)
+
+
+def _scn_worker_hang(out_dir: str) -> dict:
+    """A worker hangs past the per-cell deadline (uninterruptible
+    ``time.sleep`` — SIGALRM could not touch it): the PARENT kills the
+    worker's process group within the deadline ladder, the retry on the
+    replacement worker completes the request, and the server keeps
+    serving throughout."""
+    import time as _time
+
+    sentinel = os.path.join(out_dir, "worker_hang.once")
+    proc, client = _start_server(
+        os.path.join(out_dir, "whang"),
+        ("--workers", "1", "--cell-deadline", "0.5", "--attempts", "2"),
+    )
+    try:
+        t0 = _time.monotonic()
+        hung = client.submit({"kind": "probe", "cells": [
+            {"label": "hang", "op": "sleep", "sleep_s": 600,
+             "once": sentinel, "value": 7},
+            {"label": "after", "op": "ok", "value": 8},
+        ]}, request_id="whang", timeout=120)
+        wall = _time.monotonic() - t0
+        alive = client.submit(
+            {"kind": "probe", "cells": [{"label": "ok", "op": "ok"}]},
+            timeout=60,
+        )
+        status = client.status()
+        workers = status.get("workers") or {}
+        cells = {c["label"]: c for c in hung.get("cells", [])}
+        ok = (
+            hung.get("status") == "done" and hung.get("ok")
+            # the retried attempt (sentinel present) completed the cell —
+            # a 600s uninterruptible sleep cost one bounded deadline, not
+            # a wedged server
+            and cells["hang"].get("result", {}).get("value") == 7
+            and not cells["hang"].get("quarantined")
+            and cells["after"].get("result", {}).get("value") == 8
+            and wall < 60.0  # generous for the 1-core box; not 600
+            and alive.get("ok")
+            and workers.get("kills", 0) >= 1
+            and workers.get("restarts", 0) >= 1
+        )
+        return {"name": "worker_hang", "ok": bool(ok),
+                "wall_s": round(wall, 3),
+                "kills": workers.get("kills"),
+                "restarts": workers.get("restarts")}
+    finally:
+        _finish_server(proc, client)
+
+
 def service_chaos(out_dir: str, full: bool = False) -> dict:
     """The service chaos slice; returns a summary dict (one JSON line via
-    ``main``). Reduced (tier-1) runs the in-process-cheap drills; the
-    full slice adds the supervised SIGKILL-resume scenario
-    (``results/chaos_sweep.json`` carries the committed evidence)."""
+    ``main``). Reduced (tier-1) runs the in-process-cheap drills plus the
+    worker-pool crash/hang containment pair; the full slice adds the
+    supervised SIGKILL-resume scenario (``results/chaos_sweep.json``
+    carries the committed evidence)."""
     scenarios = [_scn_poison, _scn_backpressure, _scn_deadline, _scn_drain,
-                 _scn_tenant_flood, _scn_preempt_resume]
+                 _scn_tenant_flood, _scn_preempt_resume,
+                 _scn_worker_crash, _scn_worker_hang]
     if full:
         scenarios.append(_scn_sigkill_resume)
+    # a fresh slice starts clean: the drills use FIXED request ids, so a
+    # stale per-drill journal/spool from a previous evidence run would
+    # let a request resume instead of exercising its saboteur
+    # (resumed_skipped == cells, executed == 0, no preemption/crash).
+    # Resume WITHIN a drill — sigkill_resume's relaunch — is unaffected.
+    import shutil
+
+    shutil.rmtree(out_dir, ignore_errors=True)
+    os.makedirs(out_dir, exist_ok=True)
     rows = []
     for scn in scenarios:
         try:
@@ -1117,10 +1237,10 @@ def main() -> int:
     p.add_argument("--service", choices=("reduced", "full"), default=None,
                    help="run the simulation-service chaos slice "
                         "(blades_tpu/service): poison/backpressure/"
-                        "deadline/drain/tenant-flood/preempt-resume "
-                        "drills, plus supervised SIGKILL-resume under "
-                        "'full'; alone (no --sweep) prints just the "
-                        "slice's JSON line")
+                        "deadline/drain/tenant-flood/preempt-resume/"
+                        "worker-crash/worker-hang drills, plus "
+                        "supervised SIGKILL-resume under 'full'; alone "
+                        "(no --sweep) prints just the slice's JSON line")
     p.add_argument("--via-service", default=None, metavar="SOCK",
                    help="submit the chaos sweep as a 'sweep' request to "
                         "a running simulation service (the chaos driver "

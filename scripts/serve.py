@@ -78,6 +78,7 @@ def _start(args) -> int:
         base_delay_s=args.base_delay,
         cell_deadline_s=args.cell_deadline,
         health_interval_s=args.health_interval,
+        workers=args.workers,
     )
     snap = svc.serve()
     print(json.dumps({
@@ -159,6 +160,12 @@ def _run(argv: Optional[list] = None) -> int:
     ps.add_argument("--health-interval", type=float, default=30.0)
     ps.add_argument("--devices", type=int, default=1,
                     help="virtual-CPU device count for simulate cells")
+    ps.add_argument("--workers", type=int, default=0,
+                    help="worker-process pool size (0 = in-process "
+                         "execution, the SIGALRM path; N > 0 = requests "
+                         "execute in supervised worker processes with "
+                         "parent-enforced deadlines and crash/hang "
+                         "containment)")
     ps.set_defaults(func=_start)
 
     for name, func, extra in (

@@ -41,11 +41,20 @@ under contention, the per-tenant backpressure attribution, and the
 preempt-and-resume merge pins (``contention`` block of the payload;
 gated by ``perf_report.py --check``).
 
+After the contention ladder, a **2-worker pool ladder**
+(:func:`measure_pool`, probe-only, real worker processes) measures the
+PR 19 worker pool: crash recovery (an ``os.abort`` saboteur kills the
+busy worker mid-cell; the replacement executes exactly the unjournaled
+remainder, reply content-identical), pooled warm p99 through the pipe
+protocol + per-worker affinity routing, and the zero-compile warm pin
+measured inside the worker process (``pool`` block of the payload;
+gated by ``perf_report.py --check``).
+
 Usage::
 
     python scripts/service_baseline.py [--out results/service]
                                        [--warm-repeats N]
-                                       [--skip-contention]
+                                       [--skip-contention] [--skip-pool]
 
 Reference counterpart: none — the reference pays a cold process per
 configuration (``src/blades/simulator.py``), which is the baseline this
@@ -341,6 +350,137 @@ def measure_contention(
     }
 
 
+#: Pool-ladder shape: two workers (the sizing docs/robustness.md
+#: recommends for the 1-core box — one executing, one warming/standby),
+#: warm repeats matching the in-process ladder so the p99 bins compare.
+POOL_WORKERS = 2
+
+
+def measure_pool(
+    workers: int = POOL_WORKERS, warm_repeats: int = WARM_REPEATS,
+) -> dict:
+    """2-worker pool row (probe-only, real socket + real worker
+    PROCESSES): what the PR 19 pool promises, measured:
+
+    - **crash recovery**: an ``os.abort`` saboteur kills the busy worker
+      mid-cell; the replacement executes EXACTLY the unjournaled
+      remainder and the reply is content-identical to an undisturbed
+      run (gated by ``perf_report.py --check``);
+    - **pooled warm p99**: identical repeat requests route to the warm
+      worker (per-worker affinity) and their admission-to-reply p99 —
+      now including the pipe protocol + dispatch loop — stays bounded
+      (``service_pool_warm_p99_s``, gated);
+    - **zero-compile warm pin across the process boundary**: every
+      pooled request's compile delta is measured INSIDE its worker and
+      shipped back on the done frame — zero requests classify cold
+      (pinned).
+
+    Probe-only (jax-free) so the row measures the pool mechanics, not
+    compilation — the compilation half of the warm claim stays with the
+    in-process :func:`measure` row."""
+    import tempfile
+    import threading
+
+    from blades_tpu.service.client import ServiceClient
+    from blades_tpu.service.protocol import socket_path_for
+    from blades_tpu.service.server import SimulationService
+
+    base = tempfile.mkdtemp(prefix="service_pool_")
+    svc = SimulationService(
+        base, max_queue=8, base_delay_s=0.05, workers=workers,
+    )
+    server = threading.Thread(target=svc.serve, daemon=True,
+                              name="pool-server")
+    server.start()
+    client = ServiceClient(
+        socket_path_for(base), timeout=120,
+        connect_retries=100, connect_delay_s=0.1,
+    )
+    client.ping()
+
+    sentinel = os.path.join(base, "crash.once")
+    crash_cells = [
+        {"label": "c0", "op": "ok", "value": 0},
+        {"label": "boom", "op": "abort", "once": sentinel, "value": 1},
+        {"label": "c2", "op": "ok", "value": 2},
+        {"label": "c3", "op": "ok", "value": 3},
+    ]
+    warm_body = {"kind": "probe", "cells": [
+        {"label": f"w{i}", "op": "ok", "value": i} for i in range(3)
+    ]}
+    try:
+        # -- worker-crash recovery, undisturbed reference first ------------
+        # sentinel pre-created => the saboteur behaves; this run's reply
+        # is what the disturbed run must reproduce byte-for-byte
+        open(sentinel, "w").close()
+        ref = client.submit({"kind": "probe", "cells": crash_cells},
+                            request_id="crash-ref", timeout=120)
+        os.unlink(sentinel)
+        hurt = client.submit({"kind": "probe", "cells": crash_cells},
+                             request_id="crash-main", timeout=120)
+        summary = hurt.get("summary") or {}
+        # -- pooled warm ladder --------------------------------------------
+        for i in range(1 + max(0, int(warm_repeats))):
+            rep = client.submit(dict(warm_body),
+                                request_id=f"pool-warm-{i:02d}",
+                                timeout=120)
+            assert rep.get("ok"), rep
+        status = client.status()
+        metrics = client.metrics()
+        client.drain()
+    except BaseException:
+        try:
+            client.drain()
+        except Exception:  # noqa: BLE001 - already failing; reap the thread
+            pass
+        server.join(timeout=60)
+        raise
+    server.join(timeout=120)
+
+    warm_lat = (metrics.get("latency") or {}).get("warm") or {}
+    wsnap = status.get("workers") or {}
+    served = sorted(
+        (w.get("served", 0)
+         for w in (wsnap.get("by_worker") or {}).values()),
+        reverse=True,
+    )
+    cells = len(crash_cells)
+    resumed_skipped = summary.get("resumed_skipped", 0)
+    executed_after_crash = summary.get("executed")
+    content_identical = hurt.get("cells") == ref.get("cells")
+    cold_requests = int((metrics.get("requests") or {}).get("cold", 0))
+    return {
+        "workers": workers,
+        "crash": {
+            "cells": cells,
+            "resumed_skipped": resumed_skipped,
+            "executed_after_crash": executed_after_crash,
+            "content_identical": bool(content_identical),
+            "restarts": wsnap.get("restarts", 0),
+            "kills": wsnap.get("kills", 0),
+        },
+        "warm_requests": int((metrics.get("requests") or {}).get(
+            "warm", 0)),
+        "warm_p99_s": warm_lat.get("p99_s"),
+        "warm_latency": warm_lat,
+        # probe requests compile nothing: ANY cold-classified request
+        # means the per-worker counter plumbing broke (pinned to 0)
+        "cold_requests": cold_requests,
+        # warm-affinity proof: the repeat ladder stuck to one worker
+        "served_by_worker": served,
+        "ok": bool(
+            content_identical
+            and resumed_skipped >= 1
+            and executed_after_crash == cells - resumed_skipped
+            and wsnap.get("restarts", 0) >= 1
+            and cold_requests == 0
+            and warm_lat.get("p99_s") is not None
+            and served
+            and served[0] >= warm_repeats
+        ),
+    }
+
+
 def _run(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--out", default=os.path.join(REPO, "results", "service"))
@@ -349,6 +489,8 @@ def _run(argv=None) -> int:
                    help="extra identical warm requests for the p99 ladder")
     p.add_argument("--skip-contention", action="store_true",
                    help="skip the two-tenant contention ladder")
+    p.add_argument("--skip-pool", action="store_true",
+                   help="skip the 2-worker pool ladder")
     args = p.parse_args(argv)
     payload = measure(rounds=args.rounds, warm_repeats=args.warm_repeats)
     if not args.skip_contention:
@@ -356,6 +498,10 @@ def _run(argv=None) -> int:
         # artifact: one file, one perf_report evidence source
         payload["contention"] = measure_contention()
         payload["ok"] = bool(payload["ok"] and payload["contention"]["ok"])
+    if not args.skip_pool:
+        # the worker-pool evidence (PR 19) rides the same artifact too
+        payload["pool"] = measure_pool(warm_repeats=args.warm_repeats)
+        payload["ok"] = bool(payload["ok"] and payload["pool"]["ok"])
     os.makedirs(args.out, exist_ok=True)
     with open(os.path.join(args.out, "warm_serving.json"), "w") as f:
         json.dump(payload, f, indent=1, sort_keys=True)

@@ -21,7 +21,12 @@ two health records (a wedged server shows the age GROWING between
 snapshots, cells frozen — distinguishable from busy and from idle),
 and — from the latest ``metrics_snapshot`` record
 (``telemetry/reqpath.py``) — the rolling serving metrics: queue-wait
-share, warm-request p99, queue-depth high-water mark.
+share, warm-request p99, queue-depth high-water mark. A pooled server
+(``serve.py start --workers N``) adds a ``workers`` health block:
+busy/idle split, restarts, kills, the oldest in-flight cell age, and
+the cumulative kill/crash/replace event trail. Sweeps that ran WITHOUT
+an enforceable per-cell deadline (no SIGALRM available, no external
+enforcement) carry a ``deadline_unenforced`` count on their family row.
 
 Usage::
 
@@ -69,7 +74,7 @@ def summarize_sweeps(
              "errors": 0, "total": None, "last_cell": None, "last_ts": None,
              "eta_s": None, "batched_cells": 0, "batch_keys": set(),
              "retried": 0, "quarantined": 0, "resumed_skipped": 0,
-             "max_i": None},
+             "deadline_unenforced": 0, "max_i": None},
         )
 
     # resilient-execution trail (blades_tpu/sweeps/resilient.py): retry /
@@ -81,6 +86,12 @@ def summarize_sweeps(
             _family(r["sweep"])["retried"] += 1
         elif t == "quarantine":
             _family(r.get("sweep", "?"))["quarantined"] += 1
+        elif t == "deadline_unenforced":
+            # the resilient executor RAN WITHOUT its per-cell deadline
+            # (no SIGALRM on this thread/platform and no external
+            # enforcement): the sweep's walls are unbounded by the
+            # ladder, and the operator must know before trusting an ETA
+            _family(r.get("sweep", "?"))["deadline_unenforced"] += 1
         elif t == "resume":
             fam = _family(r.get("sweep", "?"))
             # the LAST resume record's count stands (each relaunch emits
@@ -177,6 +188,8 @@ def summarize_sweeps(
             row["quarantined"] = fam["quarantined"]
         if fam["resumed_skipped"]:
             row["resumed_skipped"] = fam["resumed_skipped"]
+        if fam["deadline_unenforced"]:
+            row["deadline_unenforced"] = fam["deadline_unenforced"]
         out[name] = row
     summary: Dict[str, Any] = {"sweeps": out, "cells": len(cells)}
     if meta:
@@ -301,8 +314,44 @@ def summarize_service(
                           "queue_depth_by_class_hwm")
                 if k in sched and sched[k]
             }
+    # worker-pool health (PR 19 worker processes): the last health
+    # snapshot's `workers` block (size / busy / idle / restarts / kills)
+    # plus the oldest in-flight cell age across workers — a hung worker
+    # shows its cell age growing toward the deadline here — and the
+    # cumulative kill / crash / replace trail from `worker` records,
+    # which survives a server that died before its next health record
+    wrecs = [r for r in records if r.get("t") == "worker"]
+    wsnap = snap.get("workers") if snap is not None else None
+    if isinstance(wsnap, dict) or wrecs:
+        wk: Dict[str, Any] = {}
+        if isinstance(wsnap, dict):
+            for field in ("size", "busy", "idle", "restarts", "kills"):
+                if field in wsnap:
+                    wk[field] = wsnap[field]
+            ages = [
+                w.get("cell_age_s")
+                for w in (wsnap.get("by_worker") or {}).values()
+                if isinstance(w, dict)
+                and isinstance(w.get("cell_age_s"), (int, float))
+            ]
+            if ages:
+                wk["oldest_cell_age_s"] = round(max(ages), 1)
+        by_event: Dict[str, int] = {}
+        for r in wrecs:
+            ev = r.get("event", "?")
+            by_event[ev] = by_event.get(ev, 0) + 1
+        if by_event:
+            wk["events"] = by_event
+            # the record trail stands in for missing snapshot counters
+            # (a crashed server's trace still reports its kill history)
+            wk.setdefault("restarts", by_event.get("replace", 0))
+            wk.setdefault(
+                "kills",
+                by_event.get("kill", 0) + by_event.get("crash", 0),
+            )
+        out["workers"] = wk
     last_ts = max(
-        (r["ts"] for r in svc + reqs + snaps
+        (r["ts"] for r in svc + reqs + snaps + wrecs
          if isinstance(r.get("ts"), (int, float))),
         default=None,
     )

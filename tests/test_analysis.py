@@ -754,6 +754,17 @@ def test_import_scheduler_before_jax():
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+def test_import_workers_before_jax():
+    """PR 19 contract: the worker-pool layer (parent dispatch/kill loop
+    AND the worker child's entry module) must import jax-free — the
+    parent never pays jax init, and a worker must reach its `ready`
+    frame in interpreter-import time."""
+    proc = _import_probe(
+        "import blades_tpu.service.workers, blades_tpu.service.worker"
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
 def test_import_analysis_tier_a_before_jax():
     """Tier A must lint (not just import) without jax — it is the gate
     that still works when the accelerator tunnel is down."""

@@ -137,13 +137,15 @@ def test_full_sweep_zero_violations(tmp_path):
 def test_service_chaos_full_slice(tmp_path):
     """The full simulation-service chaos slice (reduced slice runs
     tier-1 in tests/test_service.py): poison isolation, backpressure,
-    deadline-tripped hang, drain-no-loss, plus the supervised
+    deadline-tripped hang, drain-no-loss, tenant flood, preempt-resume,
+    worker-crash/worker-hang containment, plus the supervised
     SIGKILL-resume drill — the committed evidence run behind
     results/chaos_sweep.json's `service` block."""
     summary = chaos.service_chaos(str(tmp_path), full=True)
     assert summary["ok"], json.dumps(summary, indent=1)
     names = [s["name"] for s in summary["scenarios"]]
-    assert "sigkill_resume" in names and len(names) == 5
+    assert "sigkill_resume" in names and len(names) == 9
+    assert "worker_crash" in names and "worker_hang" in names
 
 
 @pytest.mark.slow

@@ -172,8 +172,15 @@ def test_registry_counters_rejections_and_hwm():
     assert snap["cells"] == {"done": 2, "retried": 2, "quarantined": 1}
     assert snap["rejected_by_reason"] == {"backpressure": 2, "draining": 1}
     assert snap["queue"]["depth_hwm"] == 3
-    assert snap["by_client"]["tenant1"] == {"admitted": 1, "served": 1,
-                                            "rejected": 1}
+    # counter row + the per-tenant latency histograms merged in (the
+    # victim-p99 evidence source — by_client rows are counters PLUS
+    # `latency`/`warm_latency` once the tenant has a finished request)
+    t1 = snap["by_client"]["tenant1"]
+    assert {k: t1[k] for k in ("admitted", "served", "rejected")} == {
+        "admitted": 1, "served": 1, "rejected": 1,
+    }
+    assert t1["latency"]["count"] == 1
+    assert t1["warm_latency"]["count"] == 1  # the request was warm
     assert snap["by_client"]["tenant2"] == {"rejected": 2}
     assert snap["by_op"]["probe"]["served"] == 1
     # split sums: 1 s queue wait + 2 s execute

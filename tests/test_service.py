@@ -199,12 +199,15 @@ def test_service_chaos_reduced(tmp_path):
     drain exits 0 with zero lost requests; a flooding tenant is contained
     by its quota (every reject tenant-attributed, the victim untouched);
     a preempted batch request resumes to a reply content-identical to an
-    uninterrupted run."""
+    uninterrupted run; a worker-process crash mid-cell is contained (the
+    replacement executes only the unjournaled cells, reply
+    content-identical); a hung worker is parent-killed within the
+    deadline ladder and its request completes on the replacement."""
     summary = chaos.service_chaos(str(tmp_path), full=False)
     assert summary["ok"], json.dumps(summary, indent=1)
     assert [s["name"] for s in summary["scenarios"]] == [
         "poison_isolated", "backpressure", "deadline_hang", "drain_no_loss",
-        "tenant_flood", "preempt_resume",
+        "tenant_flood", "preempt_resume", "worker_crash", "worker_hang",
     ]
 
 

@@ -1,0 +1,320 @@
+"""Worker-process execution pool (`blades_tpu/service/workers.py` +
+`worker.py`, server integration in `server.py::_work_pool`): crash/hang
+containment with parent-enforced (SIGALRM-free) deadlines.
+
+The acceptance invariants, each against a REAL `serve.py start
+--workers N` subprocess (probe-only, jax-free, server up in ~1s):
+
+- pool spawn → shutdown leaves ZERO orphans (a ``/proc`` scan over
+  every process group the pool ever spawned);
+- SIGKILL a busy worker mid-request: the server stays up, the
+  replacement executes ONLY the unjournaled cells, and the reply is
+  content-identical to an undisturbed run (the PR 13 resume invariant,
+  via worker death instead of server death);
+- a worker hung past its per-cell deadline is reaped by the PARENT's
+  group-kill ladder — no SIGALRM anywhere — and the retry completes;
+- warm-affinity routing: a repeat request lands on the worker that
+  already served its body (per-worker warm sets, scheduler pass 1);
+- ``--workers 0`` falls back to the PR 17 in-process path with an
+  identical client-visible reply and an unchanged status surface;
+- the `deadline_unenforced` note (the satellite fix for the silent
+  SIGALRM hole in `sweeps/resilient.py`) fires exactly once from a
+  non-main-thread alarm caller, is suppressed under
+  ``deadline="external"``, and surfaces in `sweep_status.py`.
+
+Reference counterpart: Ray's actor supervision in
+``src/blades/simulator.py`` — actor death is handled by the framework
+there; here every containment claim is measured.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from blades_tpu.service.client import ServiceClient, ServiceError  # noqa: E402
+from blades_tpu.service.protocol import socket_path_for  # noqa: E402
+from blades_tpu.service.workers import WorkerPool  # noqa: E402
+
+SERVE = os.path.join(REPO, "scripts", "serve.py")
+
+
+def _start(tmp_path, name, *extra, env=None):
+    out = str(tmp_path / name)
+    e = dict(os.environ, BLADES_LEDGER=str(tmp_path / f"{name}_ledger.jsonl"))
+    e.update(env or {})
+    proc = subprocess.Popen(
+        [sys.executable, SERVE, "start", "--out", out,
+         "--base-delay", "0.05", *extra],
+        env=e, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    client = ServiceClient(
+        socket_path_for(out), timeout=60,
+        connect_retries=50, connect_delay_s=0.2,
+    )
+    return out, proc, client
+
+
+def _finish(proc, client):
+    try:
+        if proc.poll() is None:
+            client.drain()
+    except ServiceError:
+        pass
+    out, err = proc.communicate(timeout=120)
+    return proc.returncode, out, err
+
+
+def _trace(out_dir):
+    path = os.path.join(out_dir, "service_trace.jsonl")
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+# -- pool lifecycle ------------------------------------------------------------
+
+
+def test_pool_spawn_ready_shutdown_zero_orphans(tmp_path):
+    """Spawn → ready → drain-ordered shutdown: every worker is its own
+    process group (never the server's), a clean shutdown needs zero
+    kills, and the /proc scan over every group the pool ever spawned
+    finds ZERO survivors."""
+    pool = WorkerPool(2, str(tmp_path))
+    pool.start()
+    try:
+        ready = set()
+        deadline = time.monotonic() + 60
+        while len(ready) < 2 and time.monotonic() < deadline:
+            for wid, ev in pool.poll(1.0):
+                if ev.get("ev") == "ready":
+                    ready.add(wid)
+                    pool.workers[wid].state = "idle"
+        assert ready == {"w0", "w1"}
+        own = os.getpgid(0)
+        assert all(h.pgid != own for h in pool.workers.values())
+        snap = pool.snapshot()
+        assert snap["size"] == 2 and snap["idle"] == 2 and snap["busy"] == 0
+    finally:
+        res = pool.shutdown()
+    assert res["survivors"] == []
+    assert res["kills"] == 0  # a ready worker exits on the shutdown frame
+    assert pool.orphans() == []
+    assert all(h.proc.poll() is not None for h in pool.workers.values())
+
+
+# -- crash containment (the acceptance e2e) ------------------------------------
+
+
+def test_sigkill_busy_worker_resume_content_identical(tmp_path):
+    """SIGKILL a worker mid-cell: the SERVER never dies, the replacement
+    worker executes ONLY the cells the dead worker had not journaled,
+    and the client-visible reply is content-identical to the undisturbed
+    run of the same request on the same server."""
+    request = {"kind": "probe", "cells": [
+        {"label": "c0", "op": "ok", "value": 0},
+        {"label": "s", "op": "sleep", "sleep_s": 3.0, "value": 1},
+        {"label": "c2", "op": "ok", "value": 2},
+    ]}
+    out, proc, client = _start(tmp_path, "sigkill", "--workers", "1")
+    try:
+        ref = client.submit(request, request_id="ref", timeout=120)
+        assert ref.get("ok") and ref.get("status") == "done"
+
+        victim = client.submit(request, request_id="victim", wait=False)
+        pid = None
+        deadline = time.monotonic() + 30
+        while pid is None and time.monotonic() < deadline:
+            st = client.status()
+            by = (st.get("workers") or {}).get("by_worker") or {}
+            for w in by.values():
+                if w.get("state") == "busy" and w.get("cell") == "s":
+                    pid = w["pid"]
+            if pid is None:
+                time.sleep(0.05)
+        assert pid is not None, "worker never reached the sleep cell"
+        os.kill(pid, signal.SIGKILL)
+
+        recovered = client.wait_result(victim["id"], timeout=120)
+        reply = recovered["reply"]
+        st = client.status()
+        workers = st.get("workers") or {}
+    finally:
+        rc, _, err = _finish(proc, client)
+    assert rc == 0, err[-2000:]
+    assert reply.get("ok")
+    assert reply["cells"] == ref["cells"]  # content-identical
+    summary = reply.get("summary") or {}
+    # c0 was journaled before the kill: recovered, never re-run
+    assert summary.get("resumed_skipped", 0) >= 1
+    assert summary.get("executed", 9) <= len(request["cells"]) - 1
+    assert workers.get("restarts", 0) >= 1
+    # the trace attributes the crash and the replacement
+    events = [r.get("event") for r in _trace(out) if r.get("t") == "worker"]
+    assert "crash" in events and "replace" in events
+
+
+# -- SIGALRM-free deadlines ----------------------------------------------------
+
+
+def test_parent_enforced_deadline_reaps_hung_worker(tmp_path):
+    """A worker hung far past its per-cell deadline is killed by the
+    PARENT (group-kill ladder — no SIGALRM in either process), the retry
+    on the replacement completes the request in bounded wall, and the
+    server serves throughout."""
+    sentinel = str(tmp_path / "hang.once")
+    out, proc, client = _start(
+        tmp_path, "deadline", "--workers", "1",
+        "--cell-deadline", "0.5", "--attempts", "2",
+    )
+    try:
+        t0 = time.monotonic()
+        reply = client.submit({"kind": "probe", "cells": [
+            {"label": "hang", "op": "sleep", "sleep_s": 600,
+             "once": sentinel, "value": 3},
+            {"label": "after", "op": "ok", "value": 4},
+        ]}, request_id="hang", timeout=120)
+        wall = time.monotonic() - t0
+        alive = client.submit(
+            {"kind": "probe", "cells": [{"label": "ok", "op": "ok"}]},
+            timeout=60,
+        )
+        st = client.status()
+        workers = st.get("workers") or {}
+    finally:
+        rc, _, err = _finish(proc, client)
+    assert rc == 0, err[-2000:]
+    assert reply.get("ok") and reply.get("status") == "done"
+    cells = {c["label"]: c for c in reply["cells"]}
+    # the retried attempt (once-sentinel present) completed the cell:
+    # a 600s uninterruptible hang cost one bounded deadline budget
+    assert cells["hang"]["result"]["value"] == 3
+    assert not cells["hang"].get("quarantined")
+    assert cells["after"]["result"]["value"] == 4
+    assert wall < 60.0
+    assert alive.get("ok")
+    assert workers.get("kills", 0) >= 1
+    assert workers.get("restarts", 0) >= 1
+    events = [r.get("event") for r in _trace(out) if r.get("t") == "worker"]
+    assert "kill" in events  # deadline kill, not crash
+
+
+# -- warm-affinity routing -----------------------------------------------------
+
+
+def test_warm_affinity_repeat_lands_on_warm_worker(tmp_path):
+    """With two idle workers, a repeat of an already-served request body
+    routes to the worker that served it (scheduler pass 1, per-worker
+    warm sets) — the other worker serves nothing."""
+    body = {"kind": "probe", "cells": [{"label": "a", "op": "ok", "value": 1}]}
+    out, proc, client = _start(tmp_path, "warm", "--workers", "2")
+    try:
+        r1 = client.submit(dict(body), request_id="r1", timeout=60)
+        r2 = client.submit(dict(body), request_id="r2", timeout=60)
+        st = client.status()
+        by = (st.get("workers") or {}).get("by_worker") or {}
+    finally:
+        rc, _, err = _finish(proc, client)
+    assert rc == 0, err[-2000:]
+    assert r1.get("ok") and r2.get("ok")
+    assert sorted(w.get("served", 0) for w in by.values()) == [0, 2]
+    fin = [r for r in _trace(out)
+           if r.get("t") == "request" and r.get("event") == "finished"]
+    assert len(fin) == 2
+    assert fin[0]["worker"] == fin[1]["worker"]
+    # probe requests compile nothing: the repeat classifies warm with a
+    # zero compile delta measured INSIDE the worker process
+    assert fin[1].get("warm") is True
+    assert fin[1].get("compiles", 1) == 0
+
+
+# -- workers=0 fallback --------------------------------------------------------
+
+
+def test_workers_zero_matches_inprocess_path(tmp_path):
+    """``--workers 0`` is the PR 17 in-process path: the same request
+    yields an identical client-visible reply, and the status surface
+    carries no ``workers`` block at all."""
+    request = {"kind": "probe", "cells": [
+        {"label": f"c{i}", "op": "ok", "value": i} for i in range(3)
+    ]}
+    out0, proc0, client0 = _start(tmp_path, "inproc")
+    try:
+        r0 = client0.submit(request, request_id="same", timeout=60)
+        st0 = client0.status()
+    finally:
+        rc0, _, err0 = _finish(proc0, client0)
+    out1, proc1, client1 = _start(tmp_path, "pooled", "--workers", "1")
+    try:
+        r1 = client1.submit(request, request_id="same", timeout=60)
+    finally:
+        rc1, _, err1 = _finish(proc1, client1)
+    assert rc0 == 0, err0[-2000:]
+    assert rc1 == 0, err1[-2000:]
+    assert "workers" not in st0
+    for key in ("ok", "status", "id", "cells", "summary"):
+        assert r0.get(key) == r1.get(key), key
+
+
+# -- the silent-deadline fix (sweeps/resilient.py satellite) -------------------
+
+
+def test_deadline_unenforced_note_surfaces(tmp_path):
+    """An alarm-mode per-cell deadline requested from a NON-main thread
+    cannot be enforced by SIGALRM: the executor emits exactly one
+    `deadline_unenforced` note (previously it silently ran unbounded),
+    `sweep_status.py` surfaces the count on the family row, and
+    ``deadline="external"`` suppresses the note (the parent owns it)."""
+    import sweep_status
+    from blades_tpu.sweeps.resilient import (
+        ResilienceOptions,
+        run_cells_resilient,
+    )
+    from blades_tpu.telemetry.timeline import SweepAccounting
+
+    def run_in_thread(trace, **opt_kw):
+        sw = SweepAccounting("certify", total=2, path=trace)
+        box = {}
+
+        def run():
+            box["out"] = run_cells_resilient(
+                [("c0", {}), ("c1", {})], lambda payload: {"ok": True},
+                sweep=sw,
+                options=ResilienceOptions(
+                    attempts=1, cell_deadline_s=0.5, sleep=lambda s: None,
+                    **opt_kw,
+                ),
+            )
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join(60)
+        sw.close()
+        with open(trace) as fh:
+            return box["out"], [json.loads(line) for line in fh]
+
+    (results, _, report), records = run_in_thread(str(tmp_path / "a.jsonl"))
+    assert results == [{"ok": True}, {"ok": True}]
+    notes = [r for r in records if r.get("t") == "deadline_unenforced"]
+    # once per execution, not per cell — a 100-cell sweep must not bury
+    # the trail under identical notes
+    assert len(notes) == 1
+    assert notes[0]["reason"] == "non_main_thread"
+    assert notes[0]["deadline_s"] == 0.5
+    summary = sweep_status.summarize_sweeps(records)
+    assert summary["sweeps"]["certify"]["deadline_unenforced"] == 1
+
+    _, records_ext = run_in_thread(
+        str(tmp_path / "b.jsonl"), deadline="external",
+    )
+    assert not [r for r in records_ext
+                if r.get("t") == "deadline_unenforced"]
+    summary_ext = sweep_status.summarize_sweeps(records_ext)
+    assert "deadline_unenforced" not in summary_ext["sweeps"]["certify"]
